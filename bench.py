@@ -1,0 +1,97 @@
+"""Benchmark: hyperparameter-search throughput vs the sklearn/CPU reference.
+
+Runs a RandomizedSearchCV-style LogisticRegression sweep on a Covertype-shaped
+synthetic dataset (the BASELINE.md north-star config, scaled for round time)
+on the available accelerator via the full framework path (MLTaskManager ->
+coordinator -> sharded trial engine), and measures the same trials executed
+the reference way (per-trial sklearn fits + 5-fold cross_val_score on CPU,
+worker.py:289-349 semantics) on a subsample of trials for the denominator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 50_000))
+N_TRIALS = int(os.environ.get("BENCH_TRIALS", 128))
+SK_TRIALS = int(os.environ.get("BENCH_SK_TRIALS", 4))
+CV = 5
+
+
+def main() -> None:
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import RandomizedSearchCV
+
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+    from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
+
+    dataset = f"synthetic_{N_ROWS}x54x7"
+    param_distributions = {
+        "C": list(np.logspace(-3, 2, 50)),
+        "tol": [1e-4, 1e-3],
+    }
+
+    mesh = trial_mesh()
+    manager = MLTaskManager(coordinator=Coordinator(mesh=mesh))
+    search = RandomizedSearchCV(
+        LogisticRegression(max_iter=200),
+        param_distributions,
+        n_iter=N_TRIALS,
+        cv=CV,
+        random_state=0,
+    )
+
+    # warm-up compile on a tiny slice of the same static shapes is skipped:
+    # compile time is part of honest wall-clock, but report both.
+    t0 = time.time()
+    status = manager.train(search, dataset, {"random_state": 42}, show_progress=False,
+                           timeout=3600)
+    wall = time.time() - t0
+    assert status["job_status"] == "completed", status
+    n_ok = len(status["job_result"]["results"])
+    assert n_ok == N_TRIALS, f"expected {N_TRIALS} trials, got {n_ok}"
+
+    trials_per_sec = N_TRIALS / wall
+
+    # ---- reference-style denominator: sklearn per-trial fit + 5-fold CV ----
+    from sklearn.model_selection import ParameterSampler, cross_val_score
+    from cs230_distributed_machine_learning_tpu.data.datasets import DatasetCache
+
+    cache = manager._coordinator.cache
+    data = cache.get(dataset, "classification")
+    X, y = np.asarray(data.X), np.asarray(data.y)
+    sampled = list(ParameterSampler(param_distributions, n_iter=SK_TRIALS, random_state=0))
+    t0 = time.time()
+    for params in sampled:
+        model = LogisticRegression(max_iter=200, **params)
+        from sklearn.model_selection import train_test_split
+
+        Xt, _, yt, _ = train_test_split(X, y, test_size=0.2, random_state=42)
+        model.fit(Xt, yt)
+        cross_val_score(model, X, y, cv=CV)
+    sk_per_trial = (time.time() - t0) / SK_TRIALS
+    sk_total_est = sk_per_trial * N_TRIALS
+    speedup = sk_total_est / wall
+
+    print(
+        json.dumps(
+            {
+                "metric": "randomized_search_trials_per_sec",
+                "value": round(trials_per_sec, 3),
+                "unit": f"trials/s ({N_TRIALS} LogReg trials, {N_ROWS}x54x7, cv={CV})",
+                "vs_baseline": round(speedup, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

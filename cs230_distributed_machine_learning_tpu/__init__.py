@@ -1,0 +1,22 @@
+"""TPU-native distributed ML training & hyperparameter-search framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+``sanjita2911/CS230-distributed-machine-learning`` (see SURVEY.md): a client
+(`MLTaskManager`) submits sklearn-style training / GridSearchCV /
+RandomizedSearchCV jobs; a coordinator expands them into per-trial subtasks; a
+placement engine schedules trial *batches* onto chips of a TPU mesh; jitted
+model kernels fit all trials of a batch in parallel (vmap over trials, sharded
+over the mesh ``trials`` axis); cross-trial/cross-fold aggregation happens
+on-device with XLA collectives instead of broker round-trips.
+
+Reference architecture being matched (not copied): client SDK
+(``DistributedLibrary/src/distributed_ml/core.py``), master
+(``aws-prod/master/master.py``), scheduler (``aws-prod/scheduler/``), worker
+(``aws-prod/worker/worker.py``) — Kafka/Redis/Flask replaced by an in-process
+async queue, an in-memory journaled store, and ICI collectives.
+"""
+
+from .version import __version__
+from .client.manager import MLTaskManager
+
+__all__ = ["MLTaskManager", "__version__"]

@@ -1,0 +1,3 @@
+from .manager import MLTaskManager
+
+__all__ = ["MLTaskManager"]

@@ -1,0 +1,227 @@
+"""MLTaskManager: the user-facing client API.
+
+Method-for-method parity with the reference SDK
+(``DistributedLibrary/src/distributed_ml/core.py:15-213``): sessions are
+created at construction; ``check_data`` / ``download_data`` / ``preprocess``
+manage datasets; ``train`` accepts a live sklearn estimator or
+GridSearchCV/RandomizedSearchCV wrapper plus ``train_params`` and optionally
+blocks with progress; ``check_job_status`` returns per-trial metrics;
+``download_best_model`` fetches the winning artifact.
+
+Two transports:
+- **local** (default, ``url=None``): talks directly to an in-process
+  Coordinator — the idiomatic single-host TPU deployment (no HTTP at all).
+- **remote** (``url=...``): REST against a coordinator server
+  (runtime/server.py), wire-compatible with the reference master's routes.
+
+Reference client quirks fixed, not copied (SURVEY.md §2.1): the broken
+status-code check (core.py:31), train() posting to the SSE endpoint but
+polling /metrics (core.py:169,178), and the 60 s default timeout.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from ..utils.config import get_config
+from ..utils.serialization import json_safe
+from .introspection import extract_model_details
+
+
+class MLTaskManager:
+    def __init__(self, url: Optional[str] = None, coordinator=None):
+        self.api_url = url.rstrip("/") if url else None
+        if self.api_url is None:
+            if coordinator is None:
+                from ..runtime.coordinator import Coordinator
+
+                coordinator = Coordinator()
+            self._coordinator = coordinator
+        else:
+            self._coordinator = None
+        self.session_id = self._create_session()
+        self.job_id: Optional[str] = None
+        self.result: Optional[Dict[str, Any]] = None
+
+    # ------------- session -------------
+
+    def _create_session(self) -> str:
+        if self._coordinator is not None:
+            return self._coordinator.create_session()
+        resp = self._request("post", "create_session")
+        return resp["session_id"]
+
+    # ------------- data management -------------
+
+    def check_data(self, data_name: str) -> Dict[str, Any]:
+        if self._coordinator is not None:
+            return self._coordinator.check_data(self.session_id, data_name)
+        return self._request(
+            "get", f"check_data/{self.session_id}", params={"dataset_name": data_name}
+        )
+
+    def download_data(self, data_link: str, data_name: str, data_type: str) -> Dict[str, Any]:
+        if self._coordinator is not None:
+            return self._coordinator.download_data(
+                self.session_id, data_link, data_name, data_type
+            )
+        return self._request(
+            "post",
+            f"download_data/{self.session_id}",
+            json={
+                "dataset_url": data_link,
+                "dataset_name": data_name,
+                "dataset_type": data_type,
+            },
+        )
+
+    def preprocess(self, dataset_id: str, config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        if self._coordinator is not None:
+            return self._coordinator.preprocess(self.session_id, dataset_id, config)
+        return self._request(
+            "post",
+            f"preprocess/{self.session_id}",
+            json={"dataset_id": dataset_id, "config": config},
+        )
+
+    # ------------- training -------------
+
+    def train(
+        self,
+        estimator: Any,
+        dataset_id: str,
+        train_params: Optional[Dict[str, Any]] = None,
+        wait_for_completion: bool = True,
+        timeout: Optional[float] = None,
+        show_progress: bool = True,
+    ) -> Dict[str, Any]:
+        """Submit a training / hyperparameter-search job.
+
+        train_params: {test_size=0.2, random_state=42, cv=5} — the plain-
+        estimator default test_size matches the reference (core.py:160-163).
+        """
+        model_details = extract_model_details(estimator)
+        train_params = dict(train_params or {})
+        train_params.setdefault("test_size", get_config().execution.default_test_size)
+        self.job_id = str(uuid.uuid4())
+        payload = {
+            "job_id": self.job_id,
+            "session_id": self.session_id,
+            "dataset_id": dataset_id,
+            "model_details": model_details,
+            "train_params": train_params,
+            "timestamp": time.time(),
+        }
+        if self._coordinator is not None:
+            submit = self._coordinator.submit_train(self.session_id, payload)
+        else:
+            submit = self._request(
+                "post", f"train/{self.session_id}", json=json_safe(payload)
+            )
+        if not wait_for_completion:
+            return submit
+        return self._wait_for_completion(timeout=timeout, show_progress=show_progress)
+
+    def _wait_for_completion(
+        self, timeout: Optional[float] = None, show_progress: bool = True
+    ) -> Dict[str, Any]:
+        cfg = get_config().service
+        timeout = timeout or cfg.client_timeout_s
+        poll = cfg.client_poll_s if self._coordinator is None else 0.05
+        bar = None
+        if show_progress:
+            try:
+                from tqdm import tqdm
+
+                bar = tqdm(total=100, desc="job", unit="%")
+            except ImportError:
+                bar = None
+        deadline = time.time() + timeout
+        try:
+            while time.time() < deadline:
+                status = self.check_status()
+                job_status = status.get("job_status")
+                if bar is not None:
+                    bar.n = int(_pct(job_status))
+                    bar.refresh()
+                if job_status == "completed":
+                    self.result = status.get("job_result")
+                    return status
+                if job_status == "failed":
+                    self.result = status.get("job_result")
+                    return status
+                time.sleep(poll)
+        finally:
+            if bar is not None:
+                bar.close()
+        raise TimeoutError(f"Job {self.job_id} did not complete within {timeout}s")
+
+    # ------------- status / results -------------
+
+    def check_status(self, job_id: Optional[str] = None) -> Dict[str, Any]:
+        jid = job_id or self.job_id
+        if self._coordinator is not None:
+            return self._coordinator.check_status(self.session_id, jid)
+        return self._request("get", f"check_status/{self.session_id}/{jid}")
+
+    def check_job_status(self, job_id: Optional[str] = None):
+        """Per-trial metrics array (the reference binds this to /metrics,
+        core.py:176-178 — kept for API parity)."""
+        jid = job_id or self.job_id
+        if self._coordinator is not None:
+            return self._coordinator.job_metrics(self.session_id, jid)
+        return self._request("get", f"metrics/{self.session_id}/{jid}")
+
+    def best_result(self, job_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        status = self.check_status(job_id)
+        result = status.get("job_result") or {}
+        return result.get("best_result")
+
+    def download_best_model(self, job_id: Optional[str] = None, output_path: Optional[str] = None) -> str:
+        jid = job_id or self.job_id
+        if self._coordinator is not None:
+            path = self._coordinator.best_model_path(self.session_id, jid)
+            if path is None:
+                raise FileNotFoundError("No best model artifact for this job")
+            if output_path:
+                import shutil
+
+                shutil.copy(path, output_path)
+                return output_path
+            return path
+        out = output_path or f"{jid}_best_model.pkl"
+        import requests
+
+        r = requests.get(
+            f"{self.api_url}/download_model/{self.session_id}/{jid}", timeout=60
+        )
+        r.raise_for_status()
+        with open(out, "wb") as f:
+            f.write(r.content)
+        return out
+
+    # ------------- REST plumbing -------------
+
+    def _request(self, method: str, endpoint: str, json=None, params=None) -> Dict[str, Any]:
+        import requests
+
+        url = f"{self.api_url}/{endpoint.lstrip('/')}"
+        resp = requests.request(
+            method, url, json=json_safe(json) if json is not None else None,
+            params=params, timeout=600,
+        )
+        resp.raise_for_status()
+        return resp.json()
+
+
+def _pct(job_status) -> float:
+    if job_status == "completed":
+        return 100.0
+    if isinstance(job_status, str) and job_status.endswith("%"):
+        try:
+            return float(job_status[:-1])
+        except ValueError:
+            return 0.0
+    return 0.0

@@ -1,0 +1,4 @@
+from .datasets import DatasetCache, collect_csv_metadata, load_table
+from .preprocess import preprocess_dataframe
+
+__all__ = ["DatasetCache", "collect_csv_metadata", "load_table", "preprocess_dataframe"]

@@ -1,0 +1,4 @@
+from .base import ModelKernel, TrialData
+from .registry import get_kernel, register_kernel, supported_models
+
+__all__ = ["ModelKernel", "TrialData", "get_kernel", "register_kernel", "supported_models"]

@@ -1,0 +1,156 @@
+"""Model-kernel protocol: sklearn-estimator semantics as jittable JAX fits.
+
+The reference executes each trial by instantiating a whitelisted sklearn
+class from strings via exec/eval and calling ``.fit`` on CPU
+(``aws-prod/worker/worker.py:36-57, 436-455``). Here every supported model
+family is a *kernel*: a pure-functional ``fit``/``predict``/``evaluate``
+triple that is jittable, vmappable over trials, and shardable over a TPU
+mesh.
+
+Hyperparameters are split into two groups per kernel:
+
+- **traced hypers** — numeric values that can vary across trials inside one
+  compiled executable (e.g. ``C``, ``alpha``). They arrive as a dict of
+  scalars (one slice of a [T]-shaped batch) so a thousand-trial search
+  compiles ONCE per static bucket, not a thousand times.
+- **static config** — anything that changes shapes or control flow
+  (``penalty`` kind, ``n_neighbors``, tree depth). Trials are bucketed by
+  static config; each bucket is one compile.
+
+This is the "hyperparameters-as-arrays" design called out in SURVEY.md §7
+(compilation economics).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+
+
+@dataclasses.dataclass(frozen=True)
+class TrialData:
+    """One dataset staged for trial execution. ``y`` is int32 class ids for
+    classification (with ``n_classes`` > 0) or float32 targets for
+    regression (``n_classes`` == 0)."""
+
+    X: Any  # [n, d] float32
+    y: Any  # [n]
+    n_classes: int = 0
+
+    @property
+    def n_samples(self) -> int:
+        return self.X.shape[0]
+
+    @property
+    def n_features(self) -> int:
+        return self.X.shape[1]
+
+
+class ModelKernel(abc.ABC):
+    """Base class for all model kernels."""
+
+    #: sklearn class name this kernel stands in for (e.g. "LogisticRegression")
+    name: str = ""
+    #: "classification" | "regression" | "transform"
+    task: str = ""
+    #: traced hyperparameter defaults, name -> float
+    hyper_defaults: Dict[str, float] = {}
+    #: static config defaults, name -> value
+    static_defaults: Dict[str, Any] = {}
+    #: sklearn get_params() noise with no bearing on the fitted function
+    #: (execution knobs, deprecated placeholders) — dropped in canonicalize
+    ignored_params: frozenset = frozenset(
+        {
+            "n_jobs",
+            "verbose",
+            "warm_start",
+            "copy_X",
+            "random_state",
+            "solver",
+            "multi_class",
+            "dual",
+            "intercept_scaling",
+            "l1_ratio",
+            "class_weight",
+            "max_fun",
+            "break_ties",
+            "cache_size",
+            "decision_function_shape",
+            "store_cv_results",
+        }
+    )
+
+    def canonicalize(self, params: Dict[str, Any]) -> Tuple[Tuple, Dict[str, float]]:
+        """Split a user parameter dict into (static_key, traced_hyper_dict).
+
+        static_key is hashable and is the compile-bucket key. Unknown
+        parameters land in the static key so they still form distinct
+        buckets instead of being silently dropped.
+        """
+        hyper = dict(self.hyper_defaults)
+        static = dict(self.static_defaults)
+        for k, v in params.items():
+            if k in self.hyper_defaults:
+                hyper[k] = float(v)
+            elif k in self.ignored_params or v == "deprecated" or (
+                v is None and k not in self.static_defaults
+            ):
+                continue
+            else:
+                static[k] = v
+        static_key = tuple(sorted((k, _hashable(v)) for k, v in static.items()))
+        return static_key, hyper
+
+    def static_from_key(self, static_key: Tuple) -> Dict[str, Any]:
+        return {k: v for k, v in static_key}
+
+    @abc.abstractmethod
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        """Fit on rows selected by {0,1} weights ``w``; returns a params pytree.
+        Must be pure and jittable."""
+
+    @abc.abstractmethod
+    def predict(self, params, X, static: Dict[str, Any]):
+        """Predict labels/values for X. Pure, jittable."""
+
+    def evaluate(self, params, X, y, w, static: Dict[str, Any]) -> Dict[str, Any]:
+        """Score on rows selected by ``w``. Returns {"score": ...} plus
+        task-specific extras (reference scoring: accuracy for classifiers,
+        r2 + MSE for regressors, worker.py:320-349)."""
+        y_pred = self.predict(params, X, static)
+        if self.task == "classification":
+            return {"score": weighted_accuracy(y, y_pred, w)}
+        return {
+            "score": weighted_r2(y, y_pred, w),
+            "mse": weighted_mse(y, y_pred, w),
+        }
+
+    # Rough per-trial working-set estimate in MB, used by the placement
+    # engine's memory-aware scoring (parity with WorkerState.mem_load_mb,
+    # scheduler_service.py:91-104). Kernels may override.
+    def memory_estimate_mb(self, n: int, d: int, static: Dict[str, Any]) -> float:
+        return max(1.0, 4.0 * n * max(d, 1) * 3 / 1e6)
+
+
+def add_intercept(X, fit_intercept: bool):
+    """[X | 1] design matrix when fitting an intercept (shared by the
+    linear-family kernels)."""
+    import jax.numpy as jnp
+
+    X = X.astype(jnp.float32)
+    if not fit_intercept:
+        return X
+    return jnp.concatenate([X, jnp.ones((X.shape[0], 1), jnp.float32)], axis=1)
+
+
+def _hashable(v: Any):
+    if isinstance(v, (list, np.ndarray)):
+        return tuple(np.asarray(v).ravel().tolist())
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v

@@ -1,0 +1,10 @@
+from .folds import SplitPlan, build_split_plan
+from .metrics import weighted_accuracy, weighted_r2, weighted_mse
+
+__all__ = [
+    "SplitPlan",
+    "build_split_plan",
+    "weighted_accuracy",
+    "weighted_r2",
+    "weighted_mse",
+]

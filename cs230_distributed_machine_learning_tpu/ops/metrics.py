@@ -1,0 +1,35 @@
+"""Weighted evaluation metrics (jittable).
+
+Parity targets: the reference worker scores classifiers with accuracy and
+regressors with r2 + MSE (``aws-prod/worker/worker.py:320-349``), and ranks
+trials by ``mean_cv_score``. All metrics here take a {0,1} sample-weight
+vector so they evaluate a masked subset of a static-shape array (see
+ops/folds.py).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-12
+
+
+def weighted_accuracy(y_true, y_pred, w):
+    w = w.astype(jnp.float32)
+    correct = (y_true == y_pred).astype(jnp.float32)
+    return jnp.sum(correct * w) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def weighted_mse(y_true, y_pred, w):
+    w = w.astype(jnp.float32)
+    err = (y_true - y_pred) ** 2
+    return jnp.sum(err * w) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def weighted_r2(y_true, y_pred, w):
+    w = w.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w), _EPS)
+    ybar = jnp.sum(y_true * w) / wsum
+    ss_res = jnp.sum(w * (y_true - y_pred) ** 2)
+    ss_tot = jnp.maximum(jnp.sum(w * (y_true - ybar) ** 2), _EPS)
+    return 1.0 - ss_res / ss_tot

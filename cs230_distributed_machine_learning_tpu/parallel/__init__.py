@@ -1,0 +1,10 @@
+from .mesh import trial_mesh, local_device_count
+from .trial_map import TrialRunResult, run_trials, fit_single
+
+__all__ = [
+    "trial_mesh",
+    "local_device_count",
+    "TrialRunResult",
+    "run_trials",
+    "fit_single",
+]

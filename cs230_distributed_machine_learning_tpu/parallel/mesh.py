@@ -1,0 +1,47 @@
+"""Device-mesh construction for trial parallelism.
+
+The reference's unit of parallelism is a Docker/EC2 worker consuming
+Kafka-keyed messages (``docker-compose.yml:133-199``); ours is a chip on a
+``jax.sharding.Mesh``. The default mesh is 1-D over all addressable devices
+with a ``trials`` axis — the idiomatic TPU form of the reference's
+"one subtask per worker" task farm (SURVEY.md §2.6). A 2-D
+(``trials``, ``data``) mesh is supported for large datasets where each
+trial's batch dimension is itself sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+def local_device_count() -> int:
+    return len(jax.devices())
+
+
+def trial_mesh(
+    devices: Optional[Sequence] = None,
+    *,
+    trial_axis: str = "trials",
+    data_axis: str = "data",
+    data_parallel: int = 1,
+) -> Mesh:
+    """Build a (trials[, data]) mesh over the given (default: all) devices."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    n = len(devs)
+    if data_parallel <= 1:
+        return Mesh(np.array(devs), (trial_axis,))
+    if n % data_parallel != 0:
+        raise ValueError(f"{n} devices not divisible by data_parallel={data_parallel}")
+    arr = np.array(devs).reshape(n // data_parallel, data_parallel)
+    return Mesh(arr, (trial_axis, data_axis))
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    if multiple <= 1:
+        return n
+    return ((n + multiple - 1) // multiple) * multiple

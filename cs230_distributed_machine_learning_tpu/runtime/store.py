@@ -1,0 +1,231 @@
+"""In-memory, journaled job/session state store.
+
+Replaces the reference's Redis instance and key schema
+(``aws-prod/master/redis_util.py:44-74``: ``active_sessions`` set,
+``active_sessions:<sid>:jobs:<jid>`` hash with total/completed/status,
+per-subtask JSON blobs, metadata hashes) with a coordinator-local store:
+plain dicts guarded by one lock, plus an append-only JSONL journal so a
+restarted coordinator can resume job state (a capability the reference
+lacks — SURVEY.md §5.4).
+
+Status semantics preserved from the reference (``task_handler.py:71-123``):
+``status`` is "pending" until the first subtask completes, then a
+percentage string, then "completed"; failed subtasks count toward
+completion (fixing the reference's stuck-job bug at ``task_handler.py:91``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..utils.serialization import json_safe
+
+
+class JobStore:
+    def __init__(self, journal_dir: Optional[str] = None):
+        self._lock = threading.RLock()
+        self._sessions: Dict[str, Dict[str, Any]] = {}
+        self._journal_path = None
+        if journal_dir:
+            os.makedirs(journal_dir, exist_ok=True)
+            self._journal_path = os.path.join(journal_dir, "jobs.jsonl")
+            self._replay()
+
+    # ---------------- sessions ----------------
+
+    def create_session(self, session_id: Optional[str] = None) -> str:
+        sid = session_id or str(uuid.uuid4())
+        with self._lock:
+            self._sessions.setdefault(
+                sid, {"created_at": time.time(), "jobs": {}}
+            )
+        self._journal({"op": "create_session", "sid": sid})
+        return sid
+
+    def has_session(self, sid: str) -> bool:
+        with self._lock:
+            return sid in self._sessions
+
+    def sessions(self) -> List[str]:
+        with self._lock:
+            return list(self._sessions)
+
+    # ---------------- jobs ----------------
+
+    def create_job(
+        self,
+        sid: str,
+        job_id: str,
+        payload: Dict[str, Any],
+        subtasks: List[Dict[str, Any]],
+        metadata: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        record = {
+            "job_id": job_id,
+            "payload": json_safe(payload),
+            "created_at": time.time(),
+            "total_subtasks": len(subtasks),
+            "completed_subtasks": 0,
+            "failed_subtasks": 0,
+            "status": "pending",
+            "subtasks": {
+                st["subtask_id"]: {"spec": json_safe(st), "status": "pending", "result": None}
+                for st in subtasks
+            },
+            "metadata": json_safe(metadata or {}),
+            "result": None,
+        }
+        with self._lock:
+            self._require_session(sid)["jobs"][job_id] = record
+        self._journal({"op": "create_job", "sid": sid, "record": record})
+
+    def update_subtask(
+        self,
+        sid: str,
+        job_id: str,
+        subtask_id: str,
+        status: str,
+        result: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            sub = job["subtasks"][subtask_id]
+            prev = sub["status"]
+            sub["status"] = status
+            if result is not None:
+                sub["result"] = json_safe(result)
+            if status in ("completed", "failed") and prev not in ("completed", "failed"):
+                if status == "completed":
+                    job["completed_subtasks"] += 1
+                else:
+                    job["failed_subtasks"] += 1
+            done = job["completed_subtasks"] + job["failed_subtasks"]
+            total = job["total_subtasks"]
+            if done < total:
+                job["status"] = f"{100.0 * done / total:.1f}%"
+        self._journal(
+            {
+                "op": "update_subtask",
+                "sid": sid,
+                "jid": job_id,
+                "stid": subtask_id,
+                "status": status,
+                "result": json_safe(result),
+            }
+        )
+
+    def finalize_job(self, sid: str, job_id: str, result: Dict[str, Any]) -> None:
+        status = "failed" if result.get("status") == "failed" else "completed"
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            job["result"] = json_safe(result)
+            job["status"] = status
+            job["completion_time"] = time.time()
+        self._journal(
+            {"op": "finalize_job", "sid": sid, "jid": job_id, "result": json_safe(result)}
+        )
+
+    def get_job(self, sid: str, job_id: str) -> Dict[str, Any]:
+        with self._lock:
+            return json.loads(json.dumps(self._require_job(sid, job_id)))
+
+    def job_progress(self, sid: str, job_id: str) -> Dict[str, Any]:
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            done = job["completed_subtasks"] + job["failed_subtasks"]
+            return {
+                "job_status": job["status"],
+                "tasks_completed": done,
+                "tasks_pending": job["total_subtasks"] - done,
+                "total_subtasks": job["total_subtasks"],
+                "job_result": job["result"]
+                if job["status"] in ("completed", "failed")
+                else None,
+            }
+
+    def subtask_results(self, sid: str, job_id: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            return [
+                json.loads(json.dumps(sub["result"]))
+                for sub in job["subtasks"].values()
+                if sub["result"] is not None
+            ]
+
+    # ---------------- internals ----------------
+
+    def _require_session(self, sid: str) -> Dict[str, Any]:
+        if sid not in self._sessions:
+            raise KeyError(f"Invalid session id: {sid}")
+        return self._sessions[sid]
+
+    def _require_job(self, sid: str, job_id: str) -> Dict[str, Any]:
+        jobs = self._require_session(sid)["jobs"]
+        if job_id not in jobs:
+            raise KeyError(f"Invalid job id: {job_id}")
+        return jobs[job_id]
+
+    def _journal(self, entry: Dict[str, Any]) -> None:
+        if not self._journal_path:
+            return
+        with self._lock:
+            with open(self._journal_path, "a") as f:
+                f.write(json.dumps(json_safe(entry)) + "\n")
+
+    def _replay(self) -> None:
+        if not (self._journal_path and os.path.exists(self._journal_path)):
+            return
+        with open(self._journal_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                op = e.get("op")
+                if op == "create_session":
+                    self._sessions.setdefault(
+                        e["sid"], {"created_at": time.time(), "jobs": {}}
+                    )
+                elif op == "create_job":
+                    self._sessions.setdefault(
+                        e["sid"], {"created_at": time.time(), "jobs": {}}
+                    )["jobs"][e["record"]["job_id"]] = e["record"]
+                elif op == "update_subtask":
+                    try:
+                        job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                        sub = job["subtasks"][e["stid"]]
+                        prev = sub["status"]
+                        sub["status"] = e["status"]
+                        if e.get("result") is not None:
+                            sub["result"] = e["result"]
+                        if e["status"] in ("completed", "failed") and prev not in (
+                            "completed",
+                            "failed",
+                        ):
+                            key = (
+                                "completed_subtasks"
+                                if e["status"] == "completed"
+                                else "failed_subtasks"
+                            )
+                            job[key] += 1
+                    except KeyError:
+                        continue
+                elif op == "finalize_job":
+                    try:
+                        job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                        job["result"] = e["result"]
+                        job["status"] = (
+                            "failed"
+                            if (e["result"] or {}).get("status") == "failed"
+                            else "completed"
+                        )
+                    except KeyError:
+                        continue

@@ -1,0 +1,62 @@
+"""Search-space expansion: job -> per-trial subtasks.
+
+Semantics parity with the reference's ``create_subtasks``
+(``aws-prod/master/task_handler.py:156-252``):
+
+- GridSearchCV  -> one subtask per ``sklearn.model_selection.ParameterGrid``
+  combination, in ParameterGrid iteration order;
+- RandomizedSearchCV -> ``ParameterSampler(param_distributions, n_iter,
+  random_state)`` draws — using sklearn's own sampler so the drawn
+  configurations (and hence ``best_params_``) are bit-identical to what
+  sklearn itself would try;
+- plain estimator -> a single subtask with ``base_estimator_params``.
+
+Subtask ids follow the reference's ``<job_id>-subtask-<i>`` scheme.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+
+def create_subtasks(
+    job_id: str,
+    session_id: str,
+    dataset_id: str,
+    model_details: Dict[str, Any],
+    train_params: Dict[str, Any],
+) -> List[Dict[str, Any]]:
+    from sklearn.model_selection import ParameterGrid, ParameterSampler
+
+    model_type = model_details["model_type"]
+    search_type = model_details.get("search_type")
+    base_params = dict(model_details.get("base_estimator_params") or {})
+
+    if search_type == "GridSearchCV":
+        grid = model_details.get("param_grid") or {}
+        combos = list(ParameterGrid(grid))
+    elif search_type == "RandomizedSearchCV":
+        dists = model_details.get("param_distributions") or {}
+        n_iter = int(model_details.get("n_iter", 10))
+        random_state = model_details.get("random_state")
+        combos = list(ParameterSampler(dists, n_iter=n_iter, random_state=random_state))
+    else:
+        combos = [{}]
+
+    cv_params = dict(model_details.get("cv_params") or {})
+    subtasks = []
+    for i, combo in enumerate(combos):
+        params = {**base_params, **combo}
+        subtasks.append(
+            {
+                "subtask_id": f"{job_id}-subtask-{i}",
+                "job_id": job_id,
+                "session_id": session_id,
+                "dataset_id": dataset_id,
+                "model_type": model_type,
+                "parameters": params,
+                "search_params": combo,
+                "train_params": {**train_params, **cv_params},
+            }
+        )
+    return subtasks

@@ -1,0 +1,12 @@
+from .config import FrameworkConfig, get_config, set_config
+from .logging import get_logger
+from .serialization import json_safe, clean_nans
+
+__all__ = [
+    "FrameworkConfig",
+    "get_config",
+    "set_config",
+    "get_logger",
+    "json_safe",
+    "clean_nans",
+]

@@ -1,0 +1,181 @@
+"""Typed, layered configuration system.
+
+Replaces the reference's three copy-pasted per-service ``config.py`` constant
+files and env-var sprinkling (reference: ``aws-prod/master/config.py:1-18``,
+``aws-prod/scheduler/scheduler.py:59-65``, ``aws-prod/worker/config.py``) with
+one dataclass hierarchy resolved as: defaults <- config file (JSON/YAML) <-
+environment variables <- explicit overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+_ENV_PREFIX = "TPUML_"
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    """Filesystem layout. Mirrors the reference's /mnt/efs shared-volume layout
+    (``aws-prod/master/config.py:11-12``) but defaults to a repo-local root."""
+
+    root: str = os.path.expanduser("~/.tpuml")
+
+    @property
+    def datasets_dir(self) -> str:
+        return os.path.join(self.root, "datasets")
+
+    @property
+    def configs_dir(self) -> str:
+        return os.path.join(self.root, "configs")
+
+    @property
+    def models_dir(self) -> str:
+        return os.path.join(self.root, "models")
+
+    @property
+    def journal_dir(self) -> str:
+        return os.path.join(self.root, "journal")
+
+    @property
+    def runtime_model_path(self) -> str:
+        return os.path.join(self.root, "runtime_predictor.joblib")
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Placement-engine knobs. Values mirror the reference's operational
+    constants (``worker.py:33``, ``scheduler_service.py:25,31,209-216``)."""
+
+    heartbeat_interval_s: float = 5.0
+    dead_after_s: float = 10.0
+    sweep_interval_s: float = 15.0
+    predictor_refit_batch: int = 10
+    default_mem_capacity_mb: float = 16000.0
+    speed_ema_alpha: float = 0.2
+    speed_factor_min: float = 0.2
+    speed_factor_max: float = 5.0
+    algo_weights: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ExecutionConfig:
+    """Trial-execution knobs for the TPU compute path."""
+
+    # mesh axis names
+    trial_axis: str = "trials"
+    data_axis: str = "data"
+    # max trials fused into one vmapped super-batch per dispatch
+    max_trials_per_batch: int = 256
+    # default dtype for fitting kernels (MXU-friendly accumulate in f32)
+    compute_dtype: str = "float32"
+    # cv defaults matching sklearn cross_val_score(cv=5)
+    default_cv_folds: int = 5
+    default_test_size: float = 0.2
+    # donate buffers / profiler toggles
+    enable_profiler: bool = False
+    profiler_dir: str = "/tmp/tpuml_traces"
+
+
+@dataclasses.dataclass
+class ServiceConfig:
+    """Control-plane endpoints (coordinator REST server + SSE cadence).
+    SSE tick mirrors the reference's 1.5 s stream loop (``master.py:266``)."""
+
+    host: str = "0.0.0.0"
+    port: int = 5001
+    sse_tick_s: float = 1.5
+    client_poll_s: float = 1.0
+    client_timeout_s: float = 600.0  # reference default of 60 s is too small
+
+
+@dataclasses.dataclass
+class FrameworkConfig:
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    execution: ExecutionConfig = dataclasses.field(default_factory=ExecutionConfig)
+    service: ServiceConfig = dataclasses.field(default_factory=ServiceConfig)
+
+    @classmethod
+    def load(
+        cls,
+        path: Optional[str] = None,
+        env: Optional[dict] = None,
+        **overrides: Any,
+    ) -> "FrameworkConfig":
+        cfg = cls()
+        if path:
+            cfg = cfg.merged(_read_config_file(path))
+        cfg = cfg.merged(_env_overrides(env if env is not None else os.environ))
+        if overrides:
+            cfg = cfg.merged(overrides)
+        return cfg
+
+    def merged(self, updates: dict) -> "FrameworkConfig":
+        return _merge_dataclass(self, updates)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _merge_dataclass(obj, updates: dict):
+    if not dataclasses.is_dataclass(obj):
+        return updates
+    kwargs = {}
+    for f in dataclasses.fields(obj):
+        cur = getattr(obj, f.name)
+        if f.name in updates:
+            upd = updates[f.name]
+            if dataclasses.is_dataclass(cur) and isinstance(upd, dict):
+                kwargs[f.name] = _merge_dataclass(cur, upd)
+            else:
+                kwargs[f.name] = upd
+        else:
+            kwargs[f.name] = cur
+    return type(obj)(**kwargs)
+
+
+def _read_config_file(path: str) -> dict:
+    text = Path(path).read_text()
+    if path.endswith((".yaml", ".yml")):
+        import yaml
+
+        return yaml.safe_load(text) or {}
+    return json.loads(text)
+
+
+def _env_overrides(env) -> dict:
+    """TPUML_SECTION__FIELD=value -> {"section": {"field": parsed}}."""
+    out: dict = {}
+    for key, raw in env.items():
+        if not key.startswith(_ENV_PREFIX):
+            continue
+        parts = key[len(_ENV_PREFIX):].lower().split("__")
+        if len(parts) != 2:
+            continue
+        section, field = parts
+        try:
+            value: Any = json.loads(raw)
+        except (json.JSONDecodeError, ValueError):
+            value = raw
+        out.setdefault(section, {})[field] = value
+    return out
+
+
+_GLOBAL_CONFIG: Optional[FrameworkConfig] = None
+
+
+def get_config() -> FrameworkConfig:
+    global _GLOBAL_CONFIG
+    if _GLOBAL_CONFIG is None:
+        _GLOBAL_CONFIG = FrameworkConfig.load()
+    return _GLOBAL_CONFIG
+
+
+def set_config(cfg: FrameworkConfig) -> None:
+    global _GLOBAL_CONFIG
+    _GLOBAL_CONFIG = cfg

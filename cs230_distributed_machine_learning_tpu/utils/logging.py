@@ -1,0 +1,36 @@
+"""Shared logging setup.
+
+One implementation instead of the reference's three copy-pasted
+``logger_util.py`` files (``aws-prod/master/logger_util.py:1-29``): console +
+optional daily-rotating file handler with 7-day retention, funcName in format.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from logging.handlers import TimedRotatingFileHandler
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s:%(funcName)s - %(message)s"
+_configured: set = set()
+
+
+def get_logger(name: str = "tpuml", log_dir: str | None = None) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if name in _configured:
+        return logger
+    logger.setLevel(logging.INFO)
+    logger.propagate = False
+    fmt = logging.Formatter(_FORMAT)
+    console = logging.StreamHandler()
+    console.setFormatter(fmt)
+    logger.addHandler(console)
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        fh = TimedRotatingFileHandler(
+            os.path.join(log_dir, "app.log"), when="midnight", backupCount=7
+        )
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    _configured.add(name)
+    return logger

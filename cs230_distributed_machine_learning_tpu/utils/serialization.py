@@ -1,0 +1,53 @@
+"""JSON-safe serialization of numpy / JAX / pandas values.
+
+Capability parity with the client-side serializer + NaN scrubber in the
+reference (``DistributedLibrary/src/distributed_ml/core.py:60-80``), extended
+to JAX arrays and used across the whole control plane (client payloads, job
+journal, REST responses).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import numpy as np
+
+
+def json_safe(obj: Any) -> Any:
+    """Recursively convert a value into plain JSON-compatible Python types."""
+    if obj is None or isinstance(obj, (bool, int, str)):
+        return obj
+    if isinstance(obj, float):
+        return None if (math.isnan(obj) or math.isinf(obj)) else obj
+    if isinstance(obj, (np.floating,)):
+        f = float(obj)
+        return None if (math.isnan(f) or math.isinf(f)) else f
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.bool_,)):
+        return bool(obj)
+    if isinstance(obj, np.ndarray):
+        return [json_safe(v) for v in obj.tolist()]
+    if isinstance(obj, dict):
+        return {str(k): json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [json_safe(v) for v in obj]
+    # jax.Array and pandas objects without importing them eagerly
+    if hasattr(obj, "tolist"):
+        return json_safe(np.asarray(obj))
+    if hasattr(obj, "to_dict"):
+        return json_safe(obj.to_dict())
+    return str(obj)
+
+
+def clean_nans(data: Any) -> Any:
+    """Recursively replace NaN/Inf floats with None (reference
+    ``core.py:71-80`` behavior)."""
+    if isinstance(data, dict):
+        return {k: clean_nans(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [clean_nans(v) for v in data]
+    if isinstance(data, float) and (math.isnan(data) or math.isinf(data)):
+        return None
+    return data

@@ -1,0 +1,23 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="cs230-distributed-machine-learning-tpu",
+    version="0.1.0",
+    description=(
+        "TPU-native distributed ML training and hyperparameter-search framework "
+        "(JAX/XLA re-design of the distributed-ml task farm)"
+    ),
+    packages=find_packages(include=["cs230_distributed_machine_learning_tpu*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "numpy",
+        "pandas",
+        "scikit-learn",
+        "pyyaml",
+    ],
+    extras_require={
+        "client": ["requests", "tqdm"],
+        "server": ["flask"],
+    },
+)

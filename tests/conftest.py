@@ -1,0 +1,45 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+Mirrors how the reference fakes its AWS fleet with docker-compose
+(SURVEY.md §4): trial-parallel/collective logic runs on 8 XLA host devices
+so scheduler and sharding behavior is exercised without TPU hardware.
+Must run before jax initializes a backend, hence the env mutation at import.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The axon TPU plugin (when present) force-registers itself regardless of
+# JAX_PLATFORMS; the config update below wins as long as it runs before
+# backend initialization.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _tmp_storage(tmp_path, monkeypatch):
+    """Point the framework's storage root at a per-test tmpdir."""
+    from cs230_distributed_machine_learning_tpu.utils.config import (
+        FrameworkConfig,
+        set_config,
+    )
+
+    cfg = FrameworkConfig.load(env={})
+    cfg.storage.root = str(tmp_path / "tpuml")
+    set_config(cfg)
+    yield
+    set_config(FrameworkConfig.load(env={}))
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
+
+    return trial_mesh()

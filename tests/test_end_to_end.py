@@ -1,0 +1,81 @@
+"""End-to-end: MLTaskManager (local mode) -> coordinator -> mesh executor."""
+
+import numpy as np
+import pytest
+from sklearn.datasets import load_iris
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+
+
+@pytest.fixture()
+def manager():
+    return MLTaskManager(coordinator=Coordinator())
+
+
+def test_plain_estimator_train_on_iris(manager):
+    status = manager.train(
+        LogisticRegression(C=1.0), "iris", {"random_state": 42}, show_progress=False
+    )
+    assert status["job_status"] == "completed"
+    result = status["job_result"]
+    best = result["best_result"]
+    assert best["accuracy"] > 0.85
+    assert best["mean_cv_score"] > 0.85
+    assert len(result["results"]) == 1
+
+
+def test_grid_search_best_params_match_sklearn(manager):
+    grid = {"C": [0.001, 0.1, 1.0, 10.0]}
+    status = manager.train(
+        GridSearchCV(LogisticRegression(max_iter=1000), grid, cv=5),
+        "iris",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    assert status["job_status"] == "completed"
+    results = status["job_result"]["results"]
+    assert len(results) == 4
+    best = status["job_result"]["best_result"]
+
+    # sklearn ground truth on the same full dataset
+    X, y = load_iris(return_X_y=True)
+    sk = GridSearchCV(LogisticRegression(max_iter=1000), grid, cv=5).fit(X, y)
+    assert best["parameters"]["C"] == sk.best_params_["C"]
+    # ranked descending by mean_cv_score
+    scores = [r["mean_cv_score"] for r in results]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_progress_and_metrics_api(manager):
+    manager.train(
+        LogisticRegression(), "iris", wait_for_completion=True, show_progress=False
+    )
+    metrics = manager.check_job_status()
+    assert len(metrics) == 1
+    assert metrics[0]["status"] == "completed"
+    status = manager.check_status()
+    assert status["job_status"] == "completed"
+
+
+def test_download_best_model(manager, tmp_path):
+    manager.train(LogisticRegression(), "iris", show_progress=False)
+    path = manager.download_best_model(output_path=str(tmp_path / "best.pkl"))
+    from cs230_distributed_machine_learning_tpu.runtime.artifacts import (
+        load_artifact,
+        predict_with_artifact,
+    )
+
+    art = load_artifact(path)
+    assert art["model_type"] == "LogisticRegression"
+    X, y = load_iris(return_X_y=True)
+    pred = np.asarray(predict_with_artifact(art, X.astype(np.float32)))
+    assert (pred == y).mean() > 0.8
+
+
+def test_invalid_session_rejected():
+    coord = Coordinator()
+    with pytest.raises(KeyError):
+        coord.submit_train("nope", {"dataset_id": "iris", "model_details": {}})

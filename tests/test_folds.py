@@ -1,0 +1,36 @@
+"""Split-plan masks must reproduce sklearn's splits exactly."""
+
+import numpy as np
+from sklearn.model_selection import KFold, StratifiedKFold, train_test_split
+
+from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+
+
+def test_holdout_matches_sklearn_split():
+    y = np.array([0, 1] * 50)
+    plan = build_split_plan(y, task="classification", n_folds=0, test_size=0.2, random_state=7)
+    idx = np.arange(100)
+    train_idx, test_idx = train_test_split(idx, test_size=0.2, random_state=7)
+    assert plan.train_w.shape == (1, 100)
+    np.testing.assert_array_equal(np.where(plan.train_w[0] == 1)[0], np.sort(train_idx))
+    np.testing.assert_array_equal(np.where(plan.eval_w[0] == 1)[0], np.sort(test_idx))
+
+
+def test_classification_folds_are_stratified_kfold():
+    rng = np.random.RandomState(0)
+    y = rng.randint(0, 3, size=90)
+    plan = build_split_plan(y, task="classification", n_folds=5, random_state=1)
+    assert plan.n_splits == 6
+    skf = StratifiedKFold(n_splits=5)
+    for row, (tr, ev) in zip(plan.train_w[1:], skf.split(np.zeros(90), y)):
+        np.testing.assert_array_equal(np.where(row == 1)[0], np.sort(tr))
+    # masks are complementary
+    np.testing.assert_array_equal(plan.train_w[1:] + plan.eval_w[1:], np.ones((5, 90)))
+
+
+def test_regression_folds_are_plain_kfold():
+    y = np.linspace(0, 1, 50)
+    plan = build_split_plan(y, task="regression", n_folds=5)
+    kf = KFold(n_splits=5)
+    for row, (tr, ev) in zip(plan.eval_w[1:], kf.split(np.zeros(50))):
+        np.testing.assert_array_equal(np.where(row == 1)[0], np.sort(ev))

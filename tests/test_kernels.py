@@ -1,0 +1,77 @@
+"""Kernel-vs-sklearn numerical parity on small data."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn.datasets import load_iris, make_regression
+from sklearn.linear_model import LinearRegression, LogisticRegression, Ridge
+
+from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+
+
+@pytest.fixture(scope="module")
+def iris():
+    X, y = load_iris(return_X_y=True)
+    return X.astype(np.float32), y.astype(np.int32)
+
+
+def _fit_full(kernel, X, y, params, n_classes):
+    static_key, hyper = kernel.canonicalize(params)
+    static = kernel.static_from_key(static_key)
+    if hasattr(kernel, "resolve_static"):
+        static = kernel.resolve_static(static, X.shape[0], X.shape[1], n_classes)
+    static["_n_classes"] = n_classes
+    w = jnp.ones(X.shape[0], jnp.float32)
+    hyper_j = {k: jnp.asarray(v, jnp.float32) for k, v in hyper.items()}
+    fitted = kernel.fit(jnp.asarray(X), jnp.asarray(y), w, hyper_j, static)
+    return fitted, static
+
+
+def test_logreg_matches_sklearn_accuracy(iris):
+    X, y = iris
+    kernel = get_kernel("LogisticRegression")
+    fitted, static = _fit_full(kernel, X, y, {"C": 1.0}, 3)
+    pred = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    ours = (pred == y).mean()
+    sk = LogisticRegression(C=1.0, max_iter=1000).fit(X, y).score(X, y)
+    assert abs(ours - sk) < 0.02, (ours, sk)
+
+
+def test_logreg_C_sensitivity(iris):
+    """Stronger regularization must change the solution (hypers are live)."""
+    X, y = iris
+    kernel = get_kernel("LogisticRegression")
+    w_strong, static = _fit_full(kernel, X, y, {"C": 1e-3}, 3)
+    w_weak, _ = _fit_full(kernel, X, y, {"C": 10.0}, 3)
+    assert float(jnp.abs(w_strong).sum()) < float(jnp.abs(w_weak).sum())
+
+
+def test_logreg_binary(iris):
+    X, y = iris
+    mask = y < 2
+    Xb, yb = X[mask], y[mask]
+    kernel = get_kernel("LogisticRegression")
+    fitted, static = _fit_full(kernel, Xb, yb, {"C": 1.0}, 2)
+    pred = np.asarray(kernel.predict(fitted, jnp.asarray(Xb), static))
+    sk = LogisticRegression(C=1.0, max_iter=1000).fit(Xb, yb)
+    assert (pred == yb).mean() >= sk.score(Xb, yb) - 0.01
+
+
+def test_linear_regression_matches_sklearn():
+    X, y = make_regression(n_samples=200, n_features=8, noise=5.0, random_state=0)
+    X = X.astype(np.float32)
+    kernel = get_kernel("LinearRegression")
+    fitted, static = _fit_full(kernel, X, y.astype(np.float32), {}, 0)
+    pred = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    sk = LinearRegression().fit(X, y)
+    np.testing.assert_allclose(pred, sk.predict(X), rtol=1e-2, atol=0.5)
+
+
+def test_ridge_matches_sklearn():
+    X, y = make_regression(n_samples=120, n_features=6, noise=2.0, random_state=1)
+    X = X.astype(np.float32)
+    kernel = get_kernel("Ridge")
+    fitted, static = _fit_full(kernel, X, y.astype(np.float32), {"alpha": 10.0}, 0)
+    coef_ours = np.asarray(fitted[:-1])
+    sk = Ridge(alpha=10.0).fit(X, y)
+    np.testing.assert_allclose(coef_ours, sk.coef_, rtol=5e-2, atol=0.3)

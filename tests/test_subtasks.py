@@ -1,0 +1,56 @@
+"""Search expansion parity with sklearn ParameterGrid / ParameterSampler."""
+
+from sklearn.model_selection import ParameterGrid, ParameterSampler
+
+from cs230_distributed_machine_learning_tpu.runtime.subtasks import create_subtasks
+
+
+def test_grid_expansion_order_and_ids():
+    grid = {"C": [0.1, 1.0], "fit_intercept": [True, False]}
+    subs = create_subtasks(
+        "job1",
+        "sess1",
+        "iris",
+        {
+            "model_type": "LogisticRegression",
+            "search_type": "GridSearchCV",
+            "param_grid": grid,
+            "base_estimator_params": {"max_iter": 200},
+        },
+        {"test_size": 0.2},
+    )
+    expected = list(ParameterGrid(grid))
+    assert len(subs) == len(expected)
+    for i, (st, combo) in enumerate(zip(subs, expected)):
+        assert st["subtask_id"] == f"job1-subtask-{i}"
+        assert st["search_params"] == combo
+        assert st["parameters"]["max_iter"] == 200
+        for k, v in combo.items():
+            assert st["parameters"][k] == v
+
+
+def test_randomized_sampling_is_reproducible():
+    dists = {"C": [0.01, 0.1, 1.0, 10.0], "tol": [1e-4, 1e-3]}
+    details = {
+        "model_type": "LogisticRegression",
+        "search_type": "RandomizedSearchCV",
+        "param_distributions": dists,
+        "n_iter": 6,
+        "random_state": 42,
+    }
+    subs = create_subtasks("j", "s", "iris", details, {})
+    expected = list(ParameterSampler(dists, n_iter=6, random_state=42))
+    assert [st["search_params"] for st in subs] == expected
+
+
+def test_plain_estimator_single_subtask():
+    subs = create_subtasks(
+        "j",
+        "s",
+        "iris",
+        {"model_type": "LogisticRegression", "search_type": None,
+         "base_estimator_params": {"C": 2.0}},
+        {},
+    )
+    assert len(subs) == 1
+    assert subs[0]["parameters"] == {"C": 2.0}
