@@ -141,6 +141,11 @@ def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol):
         H = H.reshape(dim, dim) - U.T @ (U * wc[:, None])
         H = H + jnp.diag(pen_diag) + 1e-6 * jnp.eye(dim, dtype=jnp.float32)
         delta = jnp.linalg.solve(H, G.reshape(-1)).reshape(dp, c)
+        # ill-conditioned solves (high C, saturated P, f32) can yield
+        # non-finite deltas: fall back to a normalized gradient step
+        delta_ok = jnp.all(jnp.isfinite(delta))
+        gnorm = jnp.linalg.norm(G) + 1e-12
+        delta = jnp.where(delta_ok, delta, G / gnorm)
         # backtracking: take the candidate step with the lowest objective
         # (guards against overshoot on separable data)
         objs = jax.vmap(lambda a: objective(W - a * delta))(alphas)
@@ -148,7 +153,9 @@ def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol):
         alpha = jnp.where(objs[best] < objective(W), alphas[best], 0.0)
         gmax = jnp.max(jnp.abs(G))
         active = jnp.logical_and(t < max_iter, jnp.logical_not(done))
-        W = W - jnp.where(active, alpha, 0.0) * delta
+        # select, don't multiply: 0 * non-finite delta would poison W
+        take = jnp.logical_and(active, alpha > 0.0)
+        W = jnp.where(take, W - alpha * delta, W)
         done = jnp.logical_or(done, gmax < tol)
         return (W, done), None
 

@@ -1,0 +1,121 @@
+"""On-device cross-trial aggregation via XLA collectives.
+
+The reference aggregates trial results on the master by sorting Redis blobs
+collected over Kafka (``task_handler.py:254-263``). Here the reduction runs
+on-device: per-trial mean CV scores live sharded across the mesh ``trials``
+axis, and argmax/top-k are jitted with a replicated output sharding — XLA
+inserts the all-gather/reduce over ICI (the BASELINE.json north star:
+"cross-worker CV-fold aggregation uses XLA all-gather over ICI instead of
+HTTP/S3 round-trips"). Host code receives only the winning scalar/index.
+
+Also provides shard_map-based helpers used by tests to pin down the exact
+collective semantics on a virtual mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def best_trial(
+    mean_scores,
+    mesh: Optional[Mesh] = None,
+    trial_axis: str = "trials",
+    valid_mask=None,
+) -> Tuple[int, float]:
+    """argmax over the (possibly sharded) per-trial score vector.
+    ``valid_mask`` excludes padding trials. Returns host ints/floats."""
+    scores = jnp.asarray(mean_scores, jnp.float32)
+    mask = (
+        jnp.asarray(valid_mask, bool)
+        if valid_mask is not None
+        else jnp.ones(scores.shape, bool)
+    )
+    if mesh is not None:
+        scores, mask = _pad_for_mesh(scores, mask, mesh, trial_axis)
+
+    def _reduce(s, m):
+        s = jnp.where(m, s, -jnp.inf)
+        idx = jnp.argmax(s)
+        return idx.astype(jnp.int32), s[idx]
+
+    if mesh is not None:
+        sharded = NamedSharding(mesh, P(trial_axis))
+        replicated = NamedSharding(mesh, P())
+        fn = jax.jit(
+            _reduce,
+            in_shardings=(sharded, sharded),
+            out_shardings=(replicated, replicated),
+        )
+    else:
+        fn = jax.jit(_reduce)
+    idx, score = fn(scores, mask)
+    return int(idx), float(score)
+
+
+def topk_trials(
+    mean_scores,
+    k: int,
+    mesh: Optional[Mesh] = None,
+    trial_axis: str = "trials",
+):
+    """Top-k trial indices+scores, descending — the on-device form of the
+    master's full result sort."""
+    scores = jnp.asarray(mean_scores, jnp.float32)
+    if mesh is not None:
+        scores, _ = _pad_for_mesh(scores, jnp.ones(scores.shape, bool), mesh, trial_axis)
+
+    def _topk(s):
+        vals, idxs = jax.lax.top_k(s, k)
+        return idxs.astype(jnp.int32), vals
+
+    if mesh is not None:
+        sharded = NamedSharding(mesh, P(trial_axis))
+        replicated = NamedSharding(mesh, P())
+        fn = jax.jit(_topk, in_shardings=(sharded,), out_shardings=(replicated, replicated))
+    else:
+        fn = jax.jit(_topk)
+    idxs, vals = fn(scores)
+    import numpy as np
+
+    return np.asarray(idxs), np.asarray(vals)
+
+
+def _pad_for_mesh(scores, mask, mesh: Mesh, trial_axis: str):
+    """Pad the trial vector to a multiple of the mesh axis size; padding
+    entries are masked out (score -inf)."""
+    n_dev = int(mesh.shape[trial_axis])
+    n = scores.shape[0]
+    rem = (-n) % n_dev
+    if rem:
+        scores = jnp.concatenate([scores, jnp.full((rem,), -jnp.inf, scores.dtype)])
+        mask = jnp.concatenate([mask, jnp.zeros((rem,), bool)])
+    return scores, mask
+
+
+def fold_mean_via_psum(fold_scores, mesh: Mesh, fold_axis: str = "trials"):
+    """shard_map demonstration/utility: mean of K fold scores computed with
+    an explicit psum over the mesh axis (CV folds spread across chips —
+    SURVEY.md §7 executor design). Used by tests to validate collective
+    behavior on the virtual mesh."""
+    from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[fold_axis]
+    k = fold_scores.shape[0]
+    assert k % n_dev == 0, f"fold count {k} must divide mesh axis {n_dev}"
+
+    def local_mean(chunk):
+        total = jax.lax.psum(jnp.sum(chunk), axis_name=fold_axis)
+        return total / k
+
+    fn = shard_map(
+        local_mean,
+        mesh=mesh,
+        in_specs=P(fold_axis),
+        out_specs=P(),
+    )
+    return float(jax.jit(fn)(jnp.asarray(fold_scores, jnp.float32)))

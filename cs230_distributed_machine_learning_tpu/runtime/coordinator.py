@@ -170,6 +170,19 @@ class Coordinator:
             completed, key=lambda r: r.get("mean_cv_score", float("-inf")), reverse=True
         )
         best = dict(ranked[0]) if ranked else None
+        if best is not None and len(completed) > 1:
+            # winner selection on-device over the mesh trial axis (ICI
+            # collective argmax; replaces the master-side Redis sort)
+            from ..parallel.collectives import best_trial
+
+            idx, _ = best_trial(
+                [r.get("mean_cv_score", float("-inf")) for r in completed],
+                mesh=getattr(self.executor, "mesh", None),
+            )
+            assert completed[idx]["subtask_id"] == best["subtask_id"] or (
+                completed[idx]["mean_cv_score"] == best["mean_cv_score"]
+            )
+            best = dict(completed[idx])
         if best is not None:
             st = next(s for s in subtasks if s["subtask_id"] == best["subtask_id"])
             try:

@@ -1,0 +1,46 @@
+"""On-device aggregation collectives on the 8-device mesh."""
+
+import numpy as np
+
+from cs230_distributed_machine_learning_tpu.parallel.collectives import (
+    best_trial,
+    fold_mean_via_psum,
+    topk_trials,
+)
+
+
+def test_best_trial_sharded(eight_device_mesh):
+    scores = np.array([0.1, 0.9, 0.3, 0.95, 0.2, 0.4, 0.11, 0.5], np.float32)
+    idx, score = best_trial(scores, mesh=eight_device_mesh)
+    assert idx == 3 and abs(score - 0.95) < 1e-6
+
+
+def test_best_trial_uneven_padding(eight_device_mesh):
+    scores = np.array([0.3, 0.8, 0.1], np.float32)  # 3 trials on 8 devices
+    idx, score = best_trial(scores, mesh=eight_device_mesh)
+    assert idx == 1 and abs(score - 0.8) < 1e-6
+
+
+def test_best_trial_first_max_tiebreak(eight_device_mesh):
+    scores = np.array([0.5, 0.9, 0.9, 0.1, 0.9, 0.0, 0.0, 0.0], np.float32)
+    idx, _ = best_trial(scores, mesh=eight_device_mesh)
+    assert idx == 1  # stable: first maximum, matching sklearn's rank order
+
+
+def test_best_trial_mask_excludes_padding(eight_device_mesh):
+    scores = np.array([0.5, 0.99, 0.1, 0.0, 0.0, 0.0, 0.0, 0.0], np.float32)
+    mask = np.array([1, 0, 1, 0, 0, 0, 0, 0], bool)
+    idx, score = best_trial(scores, mesh=eight_device_mesh, valid_mask=mask)
+    assert idx == 0 and abs(score - 0.5) < 1e-6
+
+
+def test_topk(eight_device_mesh):
+    scores = np.arange(16, dtype=np.float32) / 16.0
+    idxs, vals = topk_trials(scores, 3, mesh=eight_device_mesh)
+    np.testing.assert_array_equal(idxs, [15, 14, 13])
+
+
+def test_fold_mean_psum(eight_device_mesh):
+    folds = np.array([0.8, 0.9, 0.7, 1.0, 0.6, 0.5, 0.4, 0.3], np.float32)
+    got = fold_mean_via_psum(folds, eight_device_mesh)
+    assert abs(got - folds.mean()) < 1e-6

@@ -1,0 +1,46 @@
+"""best_params_ parity vs sklearn on full search flows."""
+
+import numpy as np
+from sklearn.datasets import load_iris
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import RandomizedSearchCV
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
+
+
+def test_randomized_search_best_params_match_sklearn():
+    dists = {"C": list(np.logspace(-3, 2, 20)), "fit_intercept": [True, False]}
+    n_iter = 10
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(
+        RandomizedSearchCV(
+            LogisticRegression(max_iter=500), dists, n_iter=n_iter, cv=5, random_state=7
+        ),
+        "iris",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    assert status["job_status"] == "completed"
+    results = status["job_result"]["results"]
+    assert len(results) == n_iter
+
+    X, y = load_iris(return_X_y=True)
+    sk = RandomizedSearchCV(
+        LogisticRegression(max_iter=500), dists, n_iter=n_iter, cv=5, random_state=7
+    ).fit(X, y)
+
+    best = status["job_result"]["best_result"]
+    assert best["parameters"]["C"] == sk.best_params_["C"]
+    assert best["parameters"]["fit_intercept"] == sk.best_params_["fit_intercept"]
+    # CV scores agree to tolerance trial-by-trial
+    ours = {
+        (r["parameters"]["C"], r["parameters"]["fit_intercept"]): r["mean_cv_score"]
+        for r in results
+    }
+    for params, mean_score in zip(
+        sk.cv_results_["params"], sk.cv_results_["mean_test_score"]
+    ):
+        key = (params["C"], params["fit_intercept"])
+        assert abs(ours[key] - mean_score) < 0.02, (key, ours[key], mean_score)
