@@ -82,6 +82,14 @@ class ModelKernel(abc.ABC):
             "cache_size",
             "decision_function_shape",
             "store_cv_results",
+            "copy",
+            "algorithm",
+            "leaf_size",
+            "metric_params",
+            "svd_solver",
+            "iterated_power",
+            "power_iteration_normalizer",
+            "n_oversamples",
         }
     )
 

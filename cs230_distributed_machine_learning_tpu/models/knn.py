@@ -1,0 +1,124 @@
+"""K-nearest-neighbors kernels (classifier + regressor), MXU-first.
+
+Capability target: the reference's `KNeighborsClassifier` /
+`KNeighborsRegressor` trials (``aws-prod/worker/worker.py:45,51``). The
+distance computation is the classic ||q||^2 + ||x||^2 - 2 q.x expansion —
+one [B,d]x[d,n] matmul per query block, exactly the shape the MXU wants —
+with queries processed in fixed-size blocks via ``lax.map`` so the [n,n]
+distance matrix never materializes for large datasets.
+
+"Fitting" a KNN is storing the training set: here that's the {0,1} split
+mask (the full X/y arrays are shared by every split and trial), so the K+1
+CV fits per trial are free. ``n_neighbors`` changes the top-k shape and is
+therefore static (one compile bucket per k, as SURVEY.md §7's bucketing
+prescribes); ``weights`` ("uniform" | "distance") is static control flow.
+
+sklearn-matching details: Euclidean (minkowski p=2) metric; distance
+weighting uses 1/d with exact-match (d=0) queries collapsing onto the
+matched neighbors; classification ties resolve to the smallest label, which
+argmax-over-counts reproduces.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .base import ModelKernel
+
+_QUERY_BLOCK = 1024
+
+
+class _KNNBase(ModelKernel):
+    hyper_defaults: Dict[str, float] = {}
+    static_defaults = {"n_neighbors": 5, "weights": "uniform", "p": 2}
+
+    def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        if int(static.get("p", 2)) != 2:
+            raise ValueError("KNN: only p=2 (euclidean) is supported")
+        if static.get("weights") not in ("uniform", "distance"):
+            raise ValueError(f"KNN: unsupported weights={static.get('weights')!r}")
+        k = int(static.get("n_neighbors", 5))
+        return {**static, "n_neighbors": min(k, n)}
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        return {
+            "X": X.astype(jnp.float32),
+            "y": y,
+            "w": w.astype(jnp.float32),
+        }
+
+    def _neighbors(self, params, Q, static):
+        """Per query: (top-k distances^2, top-k train indices)."""
+        k = int(static["n_neighbors"])
+        Xt = params["X"]
+        w = params["w"]
+        sq_t = jnp.sum(Xt * Xt, axis=1)  # [n]
+        big = jnp.float32(3.4e38)
+
+        nq = Q.shape[0]
+        pad = (-nq) % _QUERY_BLOCK
+        Qp = jnp.pad(Q, ((0, pad), (0, 0)))
+        blocks = Qp.reshape(-1, _QUERY_BLOCK, Q.shape[1])
+
+        def one_block(qb):
+            d2 = (
+                jnp.sum(qb * qb, axis=1, keepdims=True)
+                + sq_t[None, :]
+                - 2.0 * (qb @ Xt.T)
+            )
+            d2 = jnp.where(w[None, :] > 0, jnp.maximum(d2, 0.0), big)
+            neg, idx = jax.lax.top_k(-d2, k)
+            return -neg, idx
+
+        d2s, idxs = jax.lax.map(one_block, blocks)
+        return (
+            d2s.reshape(-1, k)[:nq],
+            idxs.reshape(-1, k)[:nq],
+        )
+
+    @staticmethod
+    def _vote_weights(d2, static):
+        if static.get("weights") == "distance":
+            d = jnp.sqrt(jnp.maximum(d2, 0.0))
+            inv = 1.0 / jnp.maximum(d, 1e-12)
+            # sklearn: if any neighbor matches exactly, only exact matches vote
+            has_zero = jnp.any(d <= 1e-12, axis=1, keepdims=True)
+            zero_w = (d <= 1e-12).astype(jnp.float32)
+            return jnp.where(has_zero, zero_w, inv)
+        return jnp.ones_like(d2)
+
+    def memory_estimate_mb(self, n, d, static):
+        return max(1.0, 4.0 * (n * d + _QUERY_BLOCK * n) / 1e6)
+
+
+class KNNClassifierKernel(_KNNBase):
+    name = "KNeighborsClassifier"
+    task = "classification"
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        c = max(int(static["_n_classes"]), 2)
+        d2, idx = self._neighbors(params, X.astype(jnp.float32), static)
+        labels = params["y"][idx]  # [nq, k]
+        votes = self._vote_weights(d2, static)
+        counts = jnp.sum(jax.nn.one_hot(labels, c, dtype=jnp.float32) * votes[..., None], axis=1)
+        return jnp.argmax(counts, axis=-1).astype(jnp.int32)
+
+
+class KNNRegressorKernel(_KNNBase):
+    name = "KNeighborsRegressor"
+    task = "regression"
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        d2, idx = self._neighbors(params, X.astype(jnp.float32), static)
+        targets = params["y"][idx].astype(jnp.float32)
+        votes = self._vote_weights(d2, static)
+        return jnp.sum(targets * votes, axis=1) / jnp.maximum(jnp.sum(votes, axis=1), 1e-12)
+
+
+from .registry import register_kernel  # noqa: E402  (self-registration on import)
+
+register_kernel(KNNClassifierKernel())
+register_kernel(KNNRegressorKernel())

@@ -1,0 +1,201 @@
+"""SVM kernels (SVC / SVR), solved in the dual on-device.
+
+Capability target: the reference's `SVC`/`SVR` trials
+(``aws-prod/worker/worker.py:44,50``) — sklearn's RBF-kernel SVMs. The
+reference fits libsvm's SMO on CPU per trial; SMO's sequential
+working-set updates are hostile to XLA, so this kernel solves the same
+box-constrained dual QP with *projected gradient ascent* and a
+power-iteration Lipschitz step — every iteration is one [n,n]x[n] matvec
+against the precomputed kernel Gram matrix, which XLA batches across
+vmapped trials into MXU-sized matmuls.
+
+The bias is handled by augmenting the kernel with a constant (+1) feature —
+i.e. a (regularized-bias) SVM without the dual equality constraint. This is
+the standard trick for first-order dual solvers; decision values differ from
+libsvm only through the bias regularization and match to score tolerance on
+real data (tests assert agreement with sklearn).
+
+Multiclass SVC follows sklearn's one-vs-one scheme: c(c-1)/2 binary
+machines fit with per-pair weight masks (more masked fits — free under
+vmap), votes aggregated with sklearn's tie-breaking (first max).
+
+Hypers: ``C`` (traced), ``epsilon`` for SVR (traced), ``gamma`` traced when
+numeric; "scale"/"auto" resolve per-fit from the masked data like sklearn.
+``kernel`` ("rbf" | "linear" | "poly") is static. Gram matrices are [n,n]
+— fits are gated to moderate n (SVMs at Covertype scale are equally
+intractable for the reference's libsvm workers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .base import ModelKernel
+
+_PG_STEPS = 600
+_MAX_N = 30_000
+
+
+def _gram(X1, X2, kernel: str, gamma, degree, coef0):
+    if kernel == "linear":
+        return X1 @ X2.T
+    if kernel == "poly":
+        return (gamma * (X1 @ X2.T) + coef0) ** degree
+    # rbf
+    d2 = (
+        jnp.sum(X1 * X1, 1)[:, None]
+        + jnp.sum(X2 * X2, 1)[None, :]
+        - 2.0 * (X1 @ X2.T)
+    )
+    return jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+
+
+def _project_box_ascent(Q, lin, lo, hi, steps=_PG_STEPS):
+    """max_a  lin.a - 0.5 a'Qa  s.t. lo <= a <= hi, by projected gradient
+    with a power-iteration step size."""
+    n = Q.shape[0]
+    v = jnp.ones((n,), jnp.float32)
+
+    def power(v, _):
+        u = Q @ v
+        return u / jnp.maximum(jnp.linalg.norm(u), 1e-12), None
+
+    v, _ = jax.lax.scan(power, v, None, length=25)
+    L = jnp.maximum(jnp.dot(v, Q @ v), 1e-6)
+    eta = 1.0 / L
+
+    def body(a, _):
+        g = lin - Q @ a
+        a = jnp.clip(a + eta * g, lo, hi)
+        return a, None
+
+    a0 = jnp.zeros((n,), jnp.float32)
+    a, _ = jax.lax.scan(body, a0, None, length=steps)
+    return a
+
+
+class SVCKernel(ModelKernel):
+    name = "SVC"
+    task = "classification"
+    hyper_defaults = {"C": 1.0}
+    static_defaults = {"kernel": "rbf", "gamma": "scale", "degree": 3, "coef0": 0.0}
+
+    def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        if n > _MAX_N:
+            raise ValueError(f"SVC: n={n} exceeds the {_MAX_N}-sample Gram-matrix gate")
+        if static.get("kernel") not in ("rbf", "linear", "poly"):
+            raise ValueError(f"SVC: unsupported kernel {static.get('kernel')!r}")
+        g = static.get("gamma", "scale")
+        if isinstance(g, (int, float)):
+            static = {**static, "_gamma_mode": "numeric", "_gamma_value": float(g)}
+        else:
+            static = {**static, "_gamma_mode": g}
+        return static
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        X = X.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        c = max(int(static["_n_classes"]), 2)
+        C = jnp.asarray(hyper["C"], jnp.float32)
+        gamma = self._gamma(X, w, static)
+        K = _gram(X, X, static["kernel"], gamma, static.get("degree", 3), static.get("coef0", 0.0))
+        K = K + 1.0  # bias via constant feature in feature space
+
+        pairs = [(i, j) for i in range(c) for j in range(i + 1, c)]
+
+        def fit_pair(pa, pb):
+            sel = ((y == pa) | (y == pb)) & (w > 0)
+            s = sel.astype(jnp.float32)
+            t = jnp.where(y == pa, 1.0, -1.0)  # +1 for class pa
+            Q = (t[:, None] * t[None, :]) * K * (s[:, None] * s[None, :])
+            # tiny diagonal keeps PG stable when rows are masked out
+            Q = Q + 1e-6 * jnp.eye(K.shape[0], dtype=jnp.float32)
+            alpha = _project_box_ascent(Q, s, 0.0, C * s)
+            return alpha * t * s  # signed dual coefs for this pair
+
+        pa = jnp.asarray([p[0] for p in pairs])
+        pb = jnp.asarray([p[1] for p in pairs])
+        coefs = jax.vmap(fit_pair)(pa, pb)  # [n_pairs, n]
+        return {"X": X, "dual": coefs, "gamma": gamma, "pairs_a": pa, "pairs_b": pb}
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        c = max(int(static["_n_classes"]), 2)
+        Kq = _gram(
+            X.astype(jnp.float32),
+            params["X"],
+            static["kernel"],
+            params["gamma"],
+            static.get("degree", 3),
+            static.get("coef0", 0.0),
+        ) + 1.0
+        dec = Kq @ params["dual"].T  # [nq, n_pairs], >0 votes class pairs_a
+        vote_a = (dec > 0).astype(jnp.float32)
+        votes = jnp.zeros((X.shape[0], c), jnp.float32)
+        votes = votes.at[:, params["pairs_a"]].add(vote_a)
+        votes = votes.at[:, params["pairs_b"]].add(1.0 - vote_a)
+        return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+    def _gamma(self, X, w, static):
+        if static.get("_gamma_mode") == "numeric":
+            return jnp.asarray(static["_gamma_value"], jnp.float32)
+        if static.get("_gamma_mode") == "auto":
+            return jnp.asarray(1.0 / X.shape[1], jnp.float32)
+        w = w.astype(jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), 1.0)
+        mean = jnp.sum(X * w[:, None], 0) / wsum
+        var = jnp.sum(w[:, None] * (X - mean) ** 2) / (wsum * X.shape[1])
+        return 1.0 / jnp.maximum(X.shape[1] * var, 1e-12)
+
+    def memory_estimate_mb(self, n, d, static):
+        return max(1.0, 4.0 * (n * n * 2 + n * d) / 1e6)
+
+
+class SVRKernel(ModelKernel):
+    name = "SVR"
+    task = "regression"
+    hyper_defaults = {"C": 1.0, "epsilon": 0.1}
+    static_defaults = {"kernel": "rbf", "gamma": "scale", "degree": 3, "coef0": 0.0}
+
+    resolve_static = SVCKernel.resolve_static
+    _gamma = SVCKernel._gamma
+    memory_estimate_mb = SVCKernel.memory_estimate_mb
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        X = X.astype(jnp.float32)
+        y = y.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        C = jnp.asarray(hyper["C"], jnp.float32)
+        eps = jnp.asarray(hyper["epsilon"], jnp.float32)
+        gamma = self._gamma(X, w, static)
+        K = _gram(X, X, static["kernel"], gamma, static.get("degree", 3), static.get("coef0", 0.0)) + 1.0
+        s = (w > 0).astype(jnp.float32)
+        n = K.shape[0]
+        # dual in beta = alpha - alpha*: max y.b - eps|b| - 0.5 b'Kb, |b|<=C.
+        # |b| term handled by solving in the split form [alpha; alpha*]>=0.
+        Ks = K * (s[:, None] * s[None, :]) + 1e-6 * jnp.eye(n, dtype=jnp.float32)
+        Q = jnp.block([[Ks, -Ks], [-Ks, Ks]])
+        lin = jnp.concatenate([(y - eps) * s, (-y - eps) * s])
+        box = jnp.concatenate([C * s, C * s])
+        a = _project_box_ascent(Q, lin, 0.0, box, steps=_PG_STEPS)
+        beta = (a[:n] - a[n:]) * s
+        return {"X": X, "dual": beta, "gamma": gamma}
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        Kq = _gram(
+            X.astype(jnp.float32),
+            params["X"],
+            static["kernel"],
+            params["gamma"],
+            static.get("degree", 3),
+            static.get("coef0", 0.0),
+        ) + 1.0
+        return Kq @ params["dual"]
+
+
+from .registry import register_kernel  # noqa: E402  (self-registration on import)
+
+register_kernel(SVCKernel())
+register_kernel(SVRKernel())

@@ -1,0 +1,185 @@
+"""Transformer kernels: StandardScaler, MinMaxScaler, PCA, OneHotEncoder,
+SimpleImputer — jitted fit/transform.
+
+Capability target: the five transformer entries of the reference's model
+whitelist (``aws-prod/worker/worker.py:53-57``). Note the reference could
+list but never actually *run* these — its training path assumes
+classifier/regressor scoring (``worker.py:320-349``) — so here they get a
+working contract instead: ``fit`` learns statistics on the weight-masked
+rows, ``predict`` IS ``transform`` (returns the transformed matrix), and
+``evaluate`` reports a transform-appropriate score (explained variance for
+PCA, fraction of finite cells for the imputer, 1.0 for scalers) so search
+jobs over transformer hyperparameters still rank.
+
+TPU shape discipline: OneHotEncoder pads every column to a static
+``max_categories`` width (one compile per cap) instead of data-dependent
+output dims.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .base import ModelKernel
+
+_EPS = 1e-12
+
+
+class _TransformBase(ModelKernel):
+    task = "transform"
+
+    def evaluate(self, params, X, y, w, static: Dict[str, Any]) -> Dict[str, Any]:
+        return {"score": jnp.asarray(1.0, jnp.float32)}
+
+
+class StandardScalerKernel(_TransformBase):
+    name = "StandardScaler"
+    static_defaults = {"with_mean": True, "with_std": True}
+
+    def fit(self, X, y, w, hyper, static):
+        X = X.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), _EPS)
+        mean = jnp.sum(X * w[:, None], axis=0) / wsum
+        var = jnp.sum(w[:, None] * (X - mean) ** 2, axis=0) / wsum
+        return {"mean": mean, "scale": jnp.sqrt(jnp.maximum(var, _EPS))}
+
+    def predict(self, params, X, static):
+        X = X.astype(jnp.float32)
+        if static.get("with_mean", True):
+            X = X - params["mean"]
+        if static.get("with_std", True):
+            X = X / params["scale"]
+        return X
+
+
+class MinMaxScalerKernel(_TransformBase):
+    name = "MinMaxScaler"
+    static_defaults = {"feature_range": (0, 1), "clip": False}
+
+    def fit(self, X, y, w, hyper, static):
+        X = X.astype(jnp.float32)
+        big = jnp.float32(3.4e38)
+        sel = w[:, None] > 0
+        return {
+            "min": jnp.min(jnp.where(sel, X, big), axis=0),
+            "max": jnp.max(jnp.where(sel, X, -big), axis=0),
+        }
+
+    def predict(self, params, X, static):
+        lo, hi = static.get("feature_range", (0, 1))
+        X = X.astype(jnp.float32)
+        span = jnp.maximum(params["max"] - params["min"], _EPS)
+        out = (X - params["min"]) / span * (hi - lo) + lo
+        if static.get("clip", False):
+            out = jnp.clip(out, lo, hi)
+        return out
+
+
+class PCAKernel(_TransformBase):
+    name = "PCA"
+    static_defaults = {"n_components": 2, "whiten": False}
+
+    def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        nc = static.get("n_components") or min(n, d)
+        if isinstance(nc, float) and 0 < nc < 1:
+            raise ValueError("PCA: fractional n_components not supported (pass an int)")
+        return {**static, "n_components": min(int(nc), d)}
+
+    def fit(self, X, y, w, hyper, static):
+        X = X.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), _EPS)
+        mean = jnp.sum(X * w[:, None], axis=0) / wsum
+        Xc = (X - mean) * jnp.sqrt(w)[:, None]
+        cov = (Xc.T @ Xc) / jnp.maximum(wsum - 1.0, 1.0)
+        evals, evecs = jnp.linalg.eigh(cov)  # ascending
+        k = int(static["n_components"])
+        comps = evecs[:, ::-1][:, :k].T  # [k, d], descending eigenvalue order
+        var = evals[::-1][:k]
+        total = jnp.maximum(jnp.sum(evals), _EPS)
+        return {
+            "mean": mean,
+            "components": comps,
+            "explained_variance": var,
+            "explained_variance_ratio": var / total,
+        }
+
+    def predict(self, params, X, static):
+        Z = (X.astype(jnp.float32) - params["mean"]) @ params["components"].T
+        if static.get("whiten", False):
+            Z = Z / jnp.sqrt(jnp.maximum(params["explained_variance"], _EPS))
+        return Z
+
+    def evaluate(self, params, X, y, w, static):
+        return {"score": jnp.sum(params["explained_variance_ratio"]).astype(jnp.float32)}
+
+
+class OneHotEncoderKernel(_TransformBase):
+    name = "OneHotEncoder"
+    static_defaults = {"max_categories": 32}
+
+    def fit(self, X, y, w, hyper, static):
+        # columns are assumed integer-coded; remember per-column maximum so
+        # transform can mask out-of-vocabulary codes
+        X = X.astype(jnp.int32)
+        sel = w[:, None] > 0
+        return {"n_cats": jnp.max(jnp.where(sel, X, -1), axis=0) + 1}
+
+    def predict(self, params, X, static):
+        cap = int(static.get("max_categories", 32))
+        X = X.astype(jnp.int32)
+        oh = jax.nn.one_hot(X, cap, dtype=jnp.float32)  # [n, d, cap]
+        valid = jnp.arange(cap)[None, :] < params["n_cats"][:, None]  # [d, cap]
+        oh = oh * valid[None, :, :]
+        n = X.shape[0]
+        return oh.reshape(n, -1)
+
+
+class SimpleImputerKernel(_TransformBase):
+    name = "SimpleImputer"
+    static_defaults = {"strategy": "mean", "fill_value": 0.0}
+
+    def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        if static.get("strategy") not in ("mean", "median", "constant"):
+            raise ValueError(f"SimpleImputer: unsupported strategy {static.get('strategy')!r}")
+        return dict(static)
+
+    def fit(self, X, y, w, hyper, static):
+        X = X.astype(jnp.float32)
+        obs = jnp.isfinite(X) & (w[:, None] > 0)
+        strategy = static.get("strategy", "mean")
+        if strategy == "median":
+            Xm = jnp.where(obs, X, jnp.nan)
+            fill = jnp.nanmedian(Xm, axis=0)
+        elif strategy == "constant":
+            fill = jnp.full((X.shape[1],), float(static.get("fill_value", 0.0)), jnp.float32)
+        else:
+            cnt = jnp.maximum(jnp.sum(obs, axis=0), 1)
+            fill = jnp.sum(jnp.where(obs, X, 0.0), axis=0) / cnt
+        return {"fill": jnp.nan_to_num(fill)}
+
+    def predict(self, params, X, static):
+        X = X.astype(jnp.float32)
+        return jnp.where(jnp.isfinite(X), X, params["fill"])
+
+    def evaluate(self, params, X, y, w, static):
+        out = self.predict(params, X, static)
+        return {"score": jnp.mean(jnp.isfinite(out).astype(jnp.float32))}
+
+
+from .registry import register_kernel  # noqa: E402  (self-registration on import)
+
+register_kernel(StandardScalerKernel())
+register_kernel(MinMaxScalerKernel())
+register_kernel(PCAKernel())
+register_kernel(OneHotEncoderKernel())
+_imputer = SimpleImputerKernel()
+register_kernel(_imputer)
+# the reference whitelist spells it "Imputer" (worker.py:57)
+_alias = SimpleImputerKernel()
+_alias.name = "Imputer"
+register_kernel(_alias)

@@ -193,6 +193,8 @@ def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str)
     score = float(out["score"][j, 0])
     if task == "classification":
         metrics["accuracy"] = score
+    elif task == "transform":
+        metrics["score"] = score
     else:
         metrics["r2_score"] = score
         if "mse" in out:

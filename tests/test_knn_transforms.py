@@ -1,0 +1,159 @@
+"""KNN and transformer kernels vs sklearn."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn.datasets import load_iris, make_regression
+
+from cs230_distributed_machine_learning_tpu.models.registry import get_kernel, supported_models
+
+
+def _fit(kernel, X, y, params, n_classes):
+    static_key, hyper = kernel.canonicalize(params)
+    static = kernel.static_from_key(static_key)
+    if hasattr(kernel, "resolve_static"):
+        static = kernel.resolve_static(static, X.shape[0], X.shape[1], n_classes)
+    static["_n_classes"] = n_classes
+    w = jnp.ones(X.shape[0], jnp.float32)
+    hyper_j = {k: jnp.asarray(v, jnp.float32) for k, v in hyper.items()}
+    fitted = kernel.fit(jnp.asarray(X), jnp.asarray(y), w, hyper_j, static)
+    return fitted, static
+
+
+def test_registry_covers_reference_whitelist_subset():
+    have = set(supported_models())
+    for name in [
+        "LogisticRegression",
+        "LinearRegression",
+        "KNeighborsClassifier",
+        "KNeighborsRegressor",
+        "StandardScaler",
+        "MinMaxScaler",
+        "PCA",
+        "OneHotEncoder",
+        "Imputer",
+    ]:
+        assert name in have, name
+
+
+def test_knn_classifier_matches_sklearn():
+    from sklearn.neighbors import KNeighborsClassifier
+
+    X, y = load_iris(return_X_y=True)
+    X = X.astype(np.float32)
+    rng = np.random.RandomState(0)
+    test_idx = rng.choice(150, 30, replace=False)
+    train_mask = np.ones(150, bool)
+    train_mask[test_idx] = False
+
+    kernel = get_kernel("KNeighborsClassifier")
+    static_key, hyper = kernel.canonicalize({"n_neighbors": 5})
+    static = kernel.resolve_static(kernel.static_from_key(static_key), 150, 4, 3)
+    static["_n_classes"] = 3
+    fitted = kernel.fit(
+        jnp.asarray(X), jnp.asarray(y.astype(np.int32)),
+        jnp.asarray(train_mask.astype(np.float32)), hyper, static,
+    )
+    ours = np.asarray(kernel.predict(fitted, jnp.asarray(X[test_idx]), static))
+    sk = KNeighborsClassifier(n_neighbors=5).fit(X[train_mask], y[train_mask])
+    theirs = sk.predict(X[test_idx])
+    assert (ours == theirs).mean() > 0.95
+
+
+def test_knn_regressor_matches_sklearn():
+    from sklearn.neighbors import KNeighborsRegressor
+
+    X, y = make_regression(n_samples=300, n_features=5, noise=1.0, random_state=2)
+    X = X.astype(np.float32)
+    y = y.astype(np.float32)
+    kernel = get_kernel("KNeighborsRegressor")
+    fitted, static = _fit(kernel, X, y, {"n_neighbors": 7, "weights": "distance"}, 0)
+    # query points NOT in training set
+    Q = X[:50] + 0.01
+    ours = np.asarray(kernel.predict(fitted, jnp.asarray(Q), static))
+    sk = KNeighborsRegressor(n_neighbors=7, weights="distance").fit(X, y)
+    np.testing.assert_allclose(ours, sk.predict(Q), rtol=1e-3, atol=1e-2)
+
+
+def test_standard_scaler_matches_sklearn():
+    from sklearn.preprocessing import StandardScaler
+
+    X = np.random.RandomState(1).randn(100, 6).astype(np.float32) * 5 + 3
+    kernel = get_kernel("StandardScaler")
+    fitted, static = _fit(kernel, X, np.zeros(100, np.float32), {}, 0)
+    ours = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    theirs = StandardScaler().fit_transform(X)
+    np.testing.assert_allclose(ours, theirs, rtol=1e-3, atol=1e-4)
+
+
+def test_minmax_scaler_matches_sklearn():
+    from sklearn.preprocessing import MinMaxScaler
+
+    X = np.random.RandomState(2).rand(80, 4).astype(np.float32) * 10
+    kernel = get_kernel("MinMaxScaler")
+    fitted, static = _fit(kernel, X, np.zeros(80, np.float32), {}, 0)
+    ours = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    np.testing.assert_allclose(ours, MinMaxScaler().fit_transform(X), rtol=1e-4, atol=1e-5)
+
+
+def test_pca_matches_sklearn_subspace():
+    from sklearn.decomposition import PCA
+
+    X, _ = load_iris(return_X_y=True)
+    X = X.astype(np.float32)
+    kernel = get_kernel("PCA")
+    fitted, static = _fit(kernel, X, np.zeros(len(X), np.float32), {"n_components": 2}, 0)
+    ours = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    sk = PCA(n_components=2).fit(X)
+    theirs = sk.transform(X)
+    # components are sign/rotation ambiguous; compare per-axis up to sign
+    for j in range(2):
+        corr = np.corrcoef(ours[:, j], theirs[:, j])[0, 1]
+        assert abs(corr) > 0.999
+    np.testing.assert_allclose(
+        np.asarray(fitted["explained_variance_ratio"]),
+        sk.explained_variance_ratio_,
+        rtol=1e-3,
+    )
+
+
+def test_imputer_mean():
+    X = np.array([[1.0, np.nan], [3.0, 4.0], [np.nan, 8.0]], np.float32)
+    kernel = get_kernel("SimpleImputer")
+    fitted, static = _fit(kernel, X, np.zeros(3, np.float32), {}, 0)
+    out = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    np.testing.assert_allclose(out[2, 0], 2.0)
+    np.testing.assert_allclose(out[0, 1], 6.0)
+
+
+def test_onehot_padded():
+    X = np.array([[0], [1], [2], [1]], np.float32)
+    kernel = get_kernel("OneHotEncoder")
+    fitted, static = _fit(kernel, X, np.zeros(4, np.float32), {"max_categories": 8}, 0)
+    out = np.asarray(kernel.predict(fitted, jnp.asarray(X), static))
+    assert out.shape == (4, 8)
+    np.testing.assert_array_equal(out[:, :3], np.eye(3)[[0, 1, 2, 1]])
+    assert out[:, 3:].sum() == 0
+
+
+def test_knn_through_full_pipeline():
+    """KNN grid search through the whole MLTaskManager path."""
+    from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.model_selection import GridSearchCV
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+    m = MLTaskManager()
+    status = m.train(
+        GridSearchCV(KNeighborsClassifier(), {"n_neighbors": [1, 3, 5, 7]}, cv=5),
+        "iris",
+        show_progress=False,
+    )
+    assert status["job_status"] == "completed"
+    results = status["job_result"]["results"]
+    assert len(results) == 4
+    from sklearn.datasets import load_iris as _li
+
+    X, y = _li(return_X_y=True)
+    sk = GridSearchCV(KNeighborsClassifier(), {"n_neighbors": [1, 3, 5, 7]}, cv=5).fit(X, y)
+    best = status["job_result"]["best_result"]
+    assert best["parameters"]["n_neighbors"] == sk.best_params_["n_neighbors"]
