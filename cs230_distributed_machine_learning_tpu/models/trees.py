@@ -1,0 +1,391 @@
+"""Tree-ensemble kernels: RandomForest and GradientBoosting (clf + reg).
+
+Capability target: the four ensemble rows of the reference whitelist
+(``aws-prod/worker/worker.py:38-49``). Built on the histogram tree core
+(ops/trees.py). Design notes:
+
+- Structural hyperparameters (n_estimators, max_depth, max_features,
+  n_bins) are static — they change scan lengths/shapes, so each combo is a
+  compile bucket; learning_rate and subsample are traced.
+- sklearn's ``max_depth=None`` (grow to purity) is capped at a static depth
+  (10) — a documented approximation; unsplittable nodes pass through, so a
+  shallower-than-cap tree is representable exactly.
+- RF bootstrap is the exact multinomial resample (n categorical draws from
+  the weight-masked rows -> per-row counts), per-node feature subsets follow
+  max_features ("sqrt"/"log2"/int/float). Forest prediction averages leaf
+  class distributions and argmaxes — sklearn's soft-vote semantics.
+- GBT is Newton-step boosting on log-loss/squared-loss gradients (leaf
+  value = sum g / sum h), with sklearn's (k-1)/k multinomial leaf scaling;
+  stages run under ``lax.scan``, trees per class under ``vmap``.
+- Trees bin features once per dataset (quantile bins) via the
+  ``prepare_data`` hook the trial engine calls once per bucket — the
+  reference re-read the CSV per subtask; we don't even re-bin.
+
+Split scores use the unified S^2/C gain rather than sklearn's exact
+friedman_mse/gini-on-sorted-values; scores match sklearn statistically
+(tests assert tolerance, not bit equality) — SURVEY.md §7 flags trees as
+the riskiest parity item and this is the deliberate trade.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.trees import bin_data, build_tree, predict_tree, quantile_bins
+from .base import ModelKernel
+
+_DEPTH_CAP = 10
+
+
+def _resolve_max_features(spec, d: int, default) -> int:
+    if spec is None:
+        spec = default
+    if spec in ("sqrt", "auto"):
+        return max(1, int(np.sqrt(d)))
+    if spec == "log2":
+        return max(1, int(np.log2(max(d, 2))))
+    if isinstance(spec, float) and 0 < spec <= 1:
+        return max(1, int(spec * d))
+    if spec in (1.0, "all"):
+        return d
+    return max(1, min(int(spec), d))
+
+
+class _TreeBase(ModelKernel):
+    #: default for max_features resolution (overridden per family)
+    _mf_default: Any = 1.0
+
+    def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        depth = static.get("max_depth")
+        depth = _DEPTH_CAP if depth is None else min(int(depth), _DEPTH_CAP)
+        n_bins = int(static.get("n_bins", 128))
+        mf = _resolve_max_features(static.get("max_features"), d, self._mf_default)
+        msl = static.get("min_samples_leaf", 1)
+        if isinstance(msl, float) and msl < 1:
+            msl = max(1, int(msl * n))
+        return {
+            **static,
+            "_depth": depth,
+            "_n_bins": n_bins,
+            "_mf": mf,
+            "_msl": float(msl),
+            "_seed": int(static.get("random_state") or 0),
+        }
+
+    # trial-engine hook: bin once per bucket, share across trials/splits
+    def prepare_data(self, X: np.ndarray, static: Dict[str, Any]):
+        edges = quantile_bins(np.asarray(X), static["_n_bins"])
+        xb = np.asarray(bin_data(X, edges))
+        return {"X": np.asarray(X, np.float32), "xb": xb, "edges": edges}
+
+    @staticmethod
+    def _query_bins(params, X, static):
+        """Accept either prepared data (dict with precomputed bins) or a raw
+        feature matrix (artifact-inference path: bin via stored edges)."""
+        if isinstance(X, dict):
+            return X["xb"]
+        return bin_data(X, params["edges"])
+
+    # random_state seeds the forest/boosting PRNG: keep it (override the
+    # base class's blanket ignore)
+    ignored_params = ModelKernel.ignored_params - {"random_state"}
+
+
+def _bootstrap_counts(key, w, n):
+    """Exact bootstrap: n draws with replacement from rows where w>0."""
+    logits = jnp.where(w > 0, 0.0, -jnp.inf)
+    idx = jax.random.categorical(key, logits, shape=(n,))
+    return jax.ops.segment_sum(jnp.ones((n,), jnp.float32), idx, num_segments=n)
+
+
+class _RandomForestBase(_TreeBase):
+    static_defaults = {
+        "n_estimators": 100,
+        "max_depth": None,
+        "min_samples_leaf": 1,
+        "min_samples_split": 2,
+        "max_features": None,
+        "bootstrap": True,
+        "random_state": 0,
+        "n_bins": 128,
+        "criterion": "default",
+        "min_weight_fraction_leaf": 0.0,
+        "max_leaf_nodes": None,
+        "min_impurity_decrease": 0.0,
+        "oob_score": False,
+        "ccp_alpha": 0.0,
+        "max_samples": None,
+        "monotonic_cst": None,
+    }
+
+    def _fit_forest(self, xb, S, C, static):
+        depth = static["_depth"]
+        n_bins = static["_n_bins"]
+        n = xb.shape[0]
+        n_trees = int(static.get("n_estimators", 100))
+        base_key = jax.random.PRNGKey(static["_seed"])
+
+        def one_tree(key):
+            boot_key, feat_key = jax.random.split(key)
+            if static.get("bootstrap", True):
+                counts = _bootstrap_counts(boot_key, C, n)
+            else:
+                counts = (C > 0).astype(jnp.float32)
+            return build_tree(
+                xb,
+                S * counts[:, None],
+                C * counts,
+                depth=depth,
+                n_bins=n_bins,
+                min_samples_leaf=static["_msl"],
+                max_features=static["_mf"],
+                key=feat_key,
+            )
+
+        keys = jax.random.split(base_key, n_trees)
+        return jax.lax.map(one_tree, keys)  # stacked tree pytree
+
+    def _forest_leaf_mean(self, params, xq, static):
+        trees = params["trees"]
+        depth = static["_depth"]
+
+        def one(tree):
+            return predict_tree(xq, tree, depth)
+
+        vals = jax.lax.map(one, trees)  # [n_trees, nq, k]
+        return jnp.mean(vals, axis=0)
+
+
+class RandomForestClassifierKernel(_RandomForestBase):
+    name = "RandomForestClassifier"
+    task = "classification"
+    _mf_default = "sqrt"
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        xb = X["xb"] if isinstance(X, dict) else X
+        c = max(int(static["_n_classes"]), 2)
+        w = w.astype(jnp.float32)
+        S = jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None]
+        trees = self._fit_forest(xb, S, w, static)
+        params = {"trees": trees}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        xq = self._query_bins(params, X, static)
+        proba = self._forest_leaf_mean(params, xq, static)
+        return jnp.argmax(proba, axis=-1).astype(jnp.int32)
+
+
+class RandomForestRegressorKernel(_RandomForestBase):
+    name = "RandomForestRegressor"
+    task = "regression"
+    _mf_default = 1.0
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        xb = X["xb"] if isinstance(X, dict) else X
+        w = w.astype(jnp.float32)
+        S = (y.astype(jnp.float32) * w)[:, None]
+        trees = self._fit_forest(xb, S, w, static)
+        params = {"trees": trees}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        xq = self._query_bins(params, X, static)
+        return self._forest_leaf_mean(params, xq, static)[:, 0]
+
+
+class _GradientBoostingBase(_TreeBase):
+    hyper_defaults = {"learning_rate": 0.1, "subsample": 1.0}
+    static_defaults = {
+        "n_estimators": 100,
+        "max_depth": 3,
+        "min_samples_leaf": 1,
+        "min_samples_split": 2,
+        "max_features": None,
+        "random_state": 0,
+        "n_bins": 128,
+        "loss": "default",
+        "criterion": "friedman_mse",
+        "init": None,
+        "alpha": 0.9,
+        "validation_fraction": 0.1,
+        "n_iter_no_change": None,
+        "tol": 1e-4,
+        "min_weight_fraction_leaf": 0.0,
+        "max_leaf_nodes": None,
+        "min_impurity_decrease": 0.0,
+        "ccp_alpha": 0.0,
+    }
+    _mf_default = 1.0
+
+
+class GradientBoostingClassifierKernel(_GradientBoostingBase):
+    name = "GradientBoostingClassifier"
+    task = "classification"
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        xb = X["xb"] if isinstance(X, dict) else X
+        c = max(int(static["_n_classes"]), 2)
+        n = xb.shape[0]
+        w = w.astype(jnp.float32)
+        depth, n_bins = static["_depth"], static["_n_bins"]
+        n_stages = int(static.get("n_estimators", 100))
+        lr = jnp.asarray(hyper["learning_rate"], jnp.float32)
+        subsample = jnp.asarray(hyper["subsample"], jnp.float32)
+        Y = jax.nn.one_hot(y, c, dtype=jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        prior = jnp.log(jnp.maximum(jnp.sum(Y * w[:, None], 0) / wsum, 1e-12))
+        leaf_scale = (c - 1) / c if c > 2 else 1.0
+        base_key = jax.random.PRNGKey(static["_seed"])
+
+        def stage(carry, key):
+            F = carry
+            sub_key, feat_key = jax.random.split(key)
+            mask = (
+                jax.random.uniform(sub_key, (n,)) < subsample
+            ).astype(jnp.float32) * w
+            P = jax.nn.softmax(F, axis=-1) if c > 2 else jax.nn.sigmoid(F)
+            if c > 2:
+                G = (Y - P) * mask[:, None]
+                H = P * (1.0 - P) * mask[:, None]
+            else:
+                G = (Y[:, 1:] - P[:, 1:]) * mask[:, None]
+                H = (P[:, 1:] * (1.0 - P[:, 1:])) * mask[:, None]
+
+            def per_class(g, h, k2):
+                tree = build_tree(
+                    xb,
+                    g[:, None],
+                    jnp.maximum(h, 1e-12),
+                    depth=depth,
+                    n_bins=n_bins,
+                    min_samples_leaf=static["_msl"],
+                    max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
+                    key=k2,
+                )
+                return tree
+
+            kdim = G.shape[1]
+            keys = jax.random.split(feat_key, kdim)
+            trees = jax.vmap(per_class, in_axes=(1, 1, 0))(G, H, keys)
+
+            def upd(tree):
+                return predict_tree(xb, tree, depth)[:, 0]
+
+            delta = jax.vmap(upd)(trees).T  # [n, kdim]
+            if c > 2:
+                F = F + lr * leaf_scale * delta
+            else:
+                F = F.at[:, 1].add(lr * delta[:, 0])
+            return F, trees
+
+        F0 = jnp.broadcast_to(prior, (n, c)) if c > 2 else jnp.stack(
+            [jnp.zeros(n), jnp.broadcast_to(prior[1] - prior[0], (n,))], axis=1
+        )
+        keys = jax.random.split(base_key, n_stages)
+        _, trees = jax.lax.scan(stage, F0, keys)
+        params = {"trees": trees, "prior": prior, "lr": lr}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        c = max(int(static["_n_classes"]), 2)
+        depth = static["_depth"]
+        xq = self._query_bins(params, X, static)
+        prior = params["prior"]
+        lr = params["lr"]
+        leaf_scale = (c - 1) / c if c > 2 else 1.0
+
+        def per_stage(F, stage_trees):
+            def upd(tree):
+                return predict_tree(xq, tree, depth)[:, 0]
+
+            delta = jax.vmap(upd)(stage_trees).T
+            if c > 2:
+                return F + lr * leaf_scale * delta, None
+            return F.at[:, 1].add(lr * delta[:, 0]), None
+
+        n = xq.shape[0]
+        F0 = (
+            jnp.broadcast_to(prior, (n, c))
+            if c > 2
+            else jnp.stack(
+                [jnp.zeros(n), jnp.broadcast_to(prior[1] - prior[0], (n,))], axis=1
+            )
+        )
+        F, _ = jax.lax.scan(per_stage, F0, params["trees"])
+        return jnp.argmax(F, axis=-1).astype(jnp.int32)
+
+
+class GradientBoostingRegressorKernel(_GradientBoostingBase):
+    name = "GradientBoostingRegressor"
+    task = "regression"
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        xb = X["xb"] if isinstance(X, dict) else X
+        n = xb.shape[0]
+        y = y.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        depth, n_bins = static["_depth"], static["_n_bins"]
+        n_stages = int(static.get("n_estimators", 100))
+        lr = jnp.asarray(hyper["learning_rate"], jnp.float32)
+        subsample = jnp.asarray(hyper["subsample"], jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        prior = jnp.sum(y * w) / wsum
+        base_key = jax.random.PRNGKey(static["_seed"])
+
+        def stage(F, key):
+            sub_key, feat_key = jax.random.split(key)
+            mask = (
+                jax.random.uniform(sub_key, (n,)) < subsample
+            ).astype(jnp.float32) * w
+            g = (y - F) * mask
+            tree = build_tree(
+                xb,
+                g[:, None],
+                mask,
+                depth=depth,
+                n_bins=n_bins,
+                min_samples_leaf=static["_msl"],
+                max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
+                key=feat_key,
+            )
+            F = F + lr * predict_tree(xb, tree, depth)[:, 0]
+            return F, tree
+
+        F0 = jnp.full((n,), prior)
+        keys = jax.random.split(base_key, n_stages)
+        _, trees = jax.lax.scan(stage, F0, keys)
+        params = {"trees": trees, "prior": prior, "lr": lr}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        depth = static["_depth"]
+        xq = self._query_bins(params, X, static)
+        lr = params["lr"]
+
+        def per_stage(F, tree):
+            return F + lr * predict_tree(xq, tree, depth)[:, 0], None
+
+        F0 = jnp.full((xq.shape[0],), params["prior"])
+        F, _ = jax.lax.scan(per_stage, F0, params["trees"])
+        return F
+
+
+from .registry import register_kernel  # noqa: E402  (self-registration on import)
+
+register_kernel(RandomForestClassifierKernel())
+register_kernel(RandomForestRegressorKernel())
+register_kernel(GradientBoostingClassifierKernel())
+register_kernel(GradientBoostingRegressorKernel())

@@ -69,7 +69,6 @@ def run_trials(
         hypers.append(hyper)
         buckets.setdefault(static_key, []).append(i)
 
-    X = jnp.asarray(data.X, jnp.float32)
     y = jnp.asarray(data.y)
     TW = jnp.asarray(plan.train_w)
     EW = jnp.asarray(plan.eval_w)
@@ -80,6 +79,13 @@ def run_trials(
         if hasattr(kernel, "resolve_static"):
             static = kernel.resolve_static(static, n, d, data.n_classes)
         static["_n_classes"] = data.n_classes
+
+        # bucket-level data prep (e.g. feature binning for trees): computed
+        # once, shared by every trial and split in the bucket
+        if hasattr(kernel, "prepare_data"):
+            X = jax.tree_util.tree_map(jnp.asarray, kernel.prepare_data(np.asarray(data.X), static))
+        else:
+            X = jnp.asarray(data.X, jnp.float32)
 
         hyper_names = sorted(hypers[idxs[0]].keys())
         chunk = min(max_trials_per_batch, pad_to_multiple(len(idxs), n_dev))
@@ -141,7 +147,12 @@ def fit_single(
         static = kernel.resolve_static(static, n, d, data.n_classes)
     static["_n_classes"] = data.n_classes
 
-    X = jnp.asarray(data.X, jnp.float32)
+    if hasattr(kernel, "prepare_data"):
+        X = jax.tree_util.tree_map(
+            jnp.asarray, kernel.prepare_data(np.asarray(data.X), static)
+        )
+    else:
+        X = jnp.asarray(data.X, jnp.float32)
     y = jnp.asarray(data.y)
     w = jnp.asarray(plan.train_w[0])
     hyper_arg = {k: jnp.asarray(v, jnp.float32) for k, v in hyper.items()}
