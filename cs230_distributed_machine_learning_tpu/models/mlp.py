@@ -1,0 +1,208 @@
+"""MLP kernels (classifier + regressor), sklearn-MLP semantics on TPU.
+
+Capability target: BASELINE.md config 5 (MLPClassifier RandomizedSearchCV on
+MNIST — "stresses per-chip jit"). Mirrors sklearn's MLPClassifier/Regressor
+defaults: relu hidden layers, minibatch Adam (batch 200), L2 penalty
+``alpha``, log-loss / squared-loss. Architecture (``hidden_layer_sizes``),
+activation, batch size, and epoch count are static (shape/trip-count);
+``alpha`` and ``learning_rate_init`` are traced so learning-rate/penalty
+sweeps share one compile.
+
+Minibatching under the split-mask regime: batches are fixed random
+permutation slices of the full (static-size) dataset with per-sample weights
+multiplying the loss — rows outside the split contribute zero gradient, so
+one compiled update serves all K+1 splits of every trial. The whole fit is
+one ``lax.scan`` over epochs x batches of a jitted Adam step — exactly the
+training-loop shape XLA pipelines best on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import ModelKernel
+
+_EPOCH_CAP = 100
+
+
+def _act(name: str):
+    return {
+        "relu": jax.nn.relu,
+        "tanh": jnp.tanh,
+        "logistic": jax.nn.sigmoid,
+        "identity": lambda x: x,
+    }[name]
+
+
+class _MLPBase(ModelKernel):
+    hyper_defaults = {"alpha": 1e-4, "learning_rate_init": 1e-3}
+    static_defaults = {
+        "hidden_layer_sizes": (100,),
+        "activation": "relu",
+        "batch_size": "auto",
+        "max_iter": 200,
+        "random_state": 0,
+        "solver": "adam",
+        "beta_1": 0.9,
+        "beta_2": 0.999,
+        "epsilon": 1e-8,
+        "shuffle": True,
+        "early_stopping": False,
+        "tol": 1e-4,
+        "learning_rate": "constant",
+        "momentum": 0.9,
+        "n_iter_no_change": 10,
+        "nesterovs_momentum": True,
+        "power_t": 0.5,
+        "validation_fraction": 0.1,
+        "max_fun": 15000,
+    }
+    ignored_params = ModelKernel.ignored_params - {"random_state", "solver", "max_fun"}
+
+    def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        hls = static.get("hidden_layer_sizes", (100,))
+        if isinstance(hls, (int, float)):
+            hls = (int(hls),)
+        hls = tuple(int(h) for h in hls)
+        bs = static.get("batch_size", "auto")
+        bs = min(200, n) if bs == "auto" else min(int(bs), n)
+        epochs = min(int(static.get("max_iter", 200)), _EPOCH_CAP)
+        if static.get("activation", "relu") not in ("relu", "tanh", "logistic", "identity"):
+            raise ValueError(f"MLP: unsupported activation {static.get('activation')!r}")
+        return {
+            **static,
+            "_hls": hls,
+            "_bs": bs,
+            "_epochs": epochs,
+            "_seed": int(static.get("random_state") or 0),
+        }
+
+    def _dims(self, d: int, static: Dict[str, Any]) -> Tuple[int, ...]:
+        out = self._out_dim(static)
+        return (d, *static["_hls"], out)
+
+    def _init(self, key, dims):
+        """sklearn's Glorot-uniform init."""
+        params = []
+        for i in range(len(dims) - 1):
+            key, sub = jax.random.split(key)
+            fan_in, fan_out = dims[i], dims[i + 1]
+            # sklearn uses factor 6 for relu/tanh/identity ("glorot")
+            bound = jnp.sqrt(6.0 / (fan_in + fan_out))
+            W = jax.random.uniform(sub, (fan_in, fan_out), jnp.float32, -bound, bound)
+            params.append({"W": W, "b": jnp.zeros((fan_out,), jnp.float32)})
+        return params
+
+    def _forward(self, params, X, static):
+        act = _act(static.get("activation", "relu"))
+        h = X
+        for layer in params[:-1]:
+            h = act(h @ layer["W"] + layer["b"])
+        return h @ params[-1]["W"] + params[-1]["b"]
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        X = X.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        n, d = X.shape
+        bs = static["_bs"]
+        epochs = static["_epochs"]
+        n_batches = max(1, n // bs)
+        alpha = jnp.asarray(hyper["alpha"], jnp.float32)
+        lr = jnp.asarray(hyper["learning_rate_init"], jnp.float32)
+        b1 = float(static.get("beta_1", 0.9))
+        b2 = float(static.get("beta_2", 0.999))
+        eps = float(static.get("epsilon", 1e-8))
+
+        dims = self._dims(d, static)
+        key = jax.random.PRNGKey(static["_seed"])
+        key, init_key = jax.random.split(key)
+        params = self._init(init_key, dims)
+        target = self._target(y, static)
+
+        def loss_fn(p, xb, tb, wb):
+            # sklearn scaling: mean batch loss + alpha/2 * ||W||^2 / batch size,
+            # with split-mask weights zeroing out-of-split rows
+            pred = self._forward(p, xb, static)
+            batch_w = jnp.maximum(jnp.sum(wb), 1e-12)
+            data_loss = jnp.sum(self._loss(pred, tb) * wb) / batch_w
+            l2 = sum(jnp.sum(layer["W"] ** 2) for layer in p)
+            return data_loss + 0.5 * alpha * l2 / batch_w
+
+        grad_fn = jax.grad(loss_fn)
+
+        m0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        v0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def step(carry, inp):
+            p, m, v, t = carry
+            idx = inp
+            xb = X[idx]
+            tb = target[idx]
+            wb = w[idx]
+            g = grad_fn(p, xb, tb, wb)
+            t = t + 1.0
+            m = jax.tree_util.tree_map(lambda a, b: b1 * a + (1 - b1) * b, m, g)
+            v = jax.tree_util.tree_map(lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
+            mhat = jax.tree_util.tree_map(lambda a: a / (1 - b1**t), m)
+            vhat = jax.tree_util.tree_map(lambda a: a / (1 - b2**t), v)
+            p = jax.tree_util.tree_map(
+                lambda a, mh, vh: a - lr * mh / (jnp.sqrt(vh) + eps), p, mhat, vhat
+            )
+            return (p, m, v, t), None
+
+        # precompute shuffled batch indices for all epochs: [epochs*n_batches, bs]
+        def epoch_perm(k):
+            return jax.random.permutation(k, n)[: n_batches * bs].reshape(n_batches, bs)
+
+        perm_keys = jax.random.split(key, epochs)
+        batches = jax.vmap(epoch_perm)(perm_keys).reshape(-1, bs)
+
+        (params, _, _, _), _ = jax.lax.scan(
+            step, (params, m0, v0, jnp.asarray(0.0)), batches
+        )
+        return params
+
+
+class MLPClassifierKernel(_MLPBase):
+    name = "MLPClassifier"
+    task = "classification"
+
+    def _out_dim(self, static):
+        return max(int(static["_n_classes"]), 2)
+
+    def _target(self, y, static):
+        return jax.nn.one_hot(y, self._out_dim(static), dtype=jnp.float32)
+
+    def _loss(self, pred, tb):
+        logp = jax.nn.log_softmax(pred, axis=-1)
+        return -jnp.sum(tb * logp, axis=-1)
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        logits = self._forward(params, X.astype(jnp.float32), static)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+class MLPRegressorKernel(_MLPBase):
+    name = "MLPRegressor"
+    task = "regression"
+
+    def _out_dim(self, static):
+        return 1
+
+    def _target(self, y, static):
+        return y.astype(jnp.float32)[:, None]
+
+    def _loss(self, pred, tb):
+        return 0.5 * jnp.sum((pred - tb) ** 2, axis=-1)
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        return self._forward(params, X.astype(jnp.float32), static)[:, 0]
+
+
+from .registry import register_kernel  # noqa: E402  (self-registration on import)
+
+register_kernel(MLPClassifierKernel())
+register_kernel(MLPRegressorKernel())
