@@ -1,0 +1,201 @@
+"""Cluster runtime: scheduler-mediated dispatch to a pool of executors.
+
+This is the process topology of the reference system — master -> Kafka
+``tasks`` -> scheduler -> Kafka ``train`` (keyed by worker) -> workers ->
+``result``/``metrics`` back (SURVEY.md §1) — collapsed onto the in-process
+TopicBus with the same message flow and the same failure semantics:
+
+  coordinator.submit -> bus:"tasks" -> PlacementEngine.place ->
+  bus:"train"(key=worker_id) -> ExecutorWorker loop -> run on mesh ->
+  bus:"result" (coordinator collects), bus:"metrics" (engine feedback)
+
+Executors heartbeat the engine; killing one (crash simulation) triggers the
+dead-worker sweep and requeue onto survivors, mirroring the reference's
+elastic recovery (scheduler_service.py:205-247). A worker drains its queue
+and hands the whole batch to the vmapped trial engine — scheduling stays
+dynamic at worker granularity while execution stays SPMD within a batch
+(the two-level resolution of SURVEY.md §7's "scheduling vs SPMD tension").
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.config import get_config
+from ..utils.logging import get_logger
+from .executor import LocalExecutor
+from .queue import TopicBus
+from .scheduler import TOPIC_TASKS, TOPIC_TRAIN, PlacementEngine
+
+logger = get_logger("tpuml.cluster")
+
+TOPIC_RESULT = "result"
+TOPIC_METRICS = "metrics"
+
+
+class ExecutorWorker:
+    """Reference-worker lifecycle (worker.py:90-286) around a mesh executor:
+    subscribe -> heartbeat thread -> keyed consume loop -> emit result+metrics."""
+
+    def __init__(self, cluster: "ClusterRuntime", executor: LocalExecutor, worker_id: str):
+        self.cluster = cluster
+        self.executor = executor
+        self.worker_id = worker_id
+        self._stop = threading.Event()
+        self._sub = cluster.bus.subscribe(TOPIC_TRAIN, key_filter=lambda k: k == worker_id)
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for target in (self._run_loop, self._heartbeat_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, graceful: bool = True) -> None:
+        self._stop.set()
+        self._sub.close()
+        if graceful:
+            self.cluster.engine.unsubscribe(self.worker_id)
+
+    def kill(self) -> None:
+        """Crash simulation: loops stop, no unsubscribe — the engine only
+        finds out via missed heartbeats."""
+        self._stop.set()
+        self._sub.close()
+
+    # ---------------- loops ----------------
+
+    def _heartbeat_loop(self) -> None:
+        interval = get_config().scheduler.heartbeat_interval_s
+        while not self._stop.wait(interval):
+            self.cluster.engine.heartbeat(self.worker_id)
+
+    def _run_loop(self) -> None:
+        max_batch = self.executor.max_trials_per_batch
+        while not self._stop.is_set():
+            try:
+                _, first = self._sub.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            batch = [first]
+            while len(batch) < max_batch:
+                try:
+                    batch.append(self._sub.get_nowait()[1])
+                except _queue.Empty:
+                    break
+            if self._stop.is_set():
+                # crash between dequeue and execution: tasks are lost here and
+                # recovered by the dead-worker requeue (at-least-once)
+                return
+            try:
+                self.executor.run_subtasks(
+                    batch,
+                    on_result=lambda stid, status, result: self.cluster.bus.publish(
+                        TOPIC_RESULT, result, key=stid
+                    ),
+                    on_metrics=lambda msg: self.cluster.bus.publish(
+                        TOPIC_METRICS, {**msg, "worker_id": self.worker_id}, key=msg.get("subtask_id")
+                    ),
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("Worker %s batch execution failed", self.worker_id)
+
+
+class ClusterRuntime:
+    def __init__(self, *, cache=None, predictor=None):
+        self.bus = TopicBus()
+        self.engine = PlacementEngine(bus=self.bus, predictor=predictor)
+        self.cache = cache
+        self.workers: Dict[str, ExecutorWorker] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        for target in (self._ingress_loop, self._metrics_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.engine.start_monitor()
+
+    # ---------------- executor pool ----------------
+
+    def add_executor(
+        self, mesh=None, mem_capacity_mb: Optional[float] = None, executor: Optional[LocalExecutor] = None
+    ) -> str:
+        wid = self.engine.subscribe(mem_capacity_mb=mem_capacity_mb)
+        executor = executor or LocalExecutor(executor_id=wid, mesh=mesh, cache=self.cache)
+        executor.executor_id = wid
+        worker = ExecutorWorker(self, executor, wid)
+        self.workers[wid] = worker
+        worker.start()
+        return wid
+
+    def remove_executor(self, worker_id: str, graceful: bool = True) -> None:
+        worker = self.workers.pop(worker_id, None)
+        if worker is not None:
+            worker.stop(graceful=graceful)
+
+    def kill_executor(self, worker_id: str) -> None:
+        """Fault injection: crash a worker without unsubscribe."""
+        worker = self.workers.pop(worker_id, None)
+        if worker is not None:
+            worker.kill()
+
+    # ---------------- job submission ----------------
+
+    def submit(self, subtasks: List[Dict[str, Any]], metadata: Optional[Dict[str, Any]] = None) -> None:
+        for st in subtasks:
+            task = dict(st)
+            if metadata:
+                task["metadata"] = metadata
+            task["mem_estimate_mb"] = self._mem_estimate(task)
+            self.bus.publish(TOPIC_TASKS, task)
+
+    @staticmethod
+    def _mem_estimate(task: Dict[str, Any]) -> float:
+        try:
+            from ..models.registry import get_kernel
+
+            meta = task.get("metadata") or {}
+            kernel = get_kernel(task["model_type"])
+            return kernel.memory_estimate_mb(
+                int(meta.get("n_rows", 1000) or 1000),
+                int(meta.get("n_cols", 10) or 10),
+                {},
+            )
+        except Exception:  # noqa: BLE001
+            return 1.0
+
+    # ---------------- internal loops ----------------
+
+    def _ingress_loop(self) -> None:
+        sub = self.bus.subscribe(TOPIC_TASKS)
+        while not self._stop.is_set():
+            try:
+                _, task = sub.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            wid = self.engine.place(task)
+            if wid is None:
+                # no executors yet: park and retry
+                time.sleep(0.1)
+                self.bus.publish(TOPIC_TASKS, task)
+
+    def _metrics_loop(self) -> None:
+        sub = self.bus.subscribe(TOPIC_METRICS)
+        while not self._stop.is_set():
+            try:
+                _, msg = sub.get(timeout=0.2)
+            except _queue.Empty:
+                continue
+            try:
+                self.engine.on_metrics(msg)
+            except Exception:  # noqa: BLE001
+                logger.exception("Metrics feedback failed")
+
+    def shutdown(self) -> None:
+        for wid in list(self.workers):
+            self.remove_executor(wid)
+        self._stop.set()
+        self.engine.stop_monitor()

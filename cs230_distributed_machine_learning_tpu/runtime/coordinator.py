@@ -42,14 +42,27 @@ class Coordinator:
         *,
         mesh=None,
         executor: Optional[LocalExecutor] = None,
+        cluster=None,
         journal: bool = False,
     ):
+        """Two dispatch modes: direct (default — one in-process executor, the
+        single-host TPU deployment) and scheduled (``cluster=`` a
+        ClusterRuntime — placement-engine dispatch over an executor pool
+        with heartbeats/requeue, the reference's full topology)."""
         self.config = config or get_config()
-        self.bus = TopicBus()
+        self.cluster = cluster
+        self.bus = cluster.bus if cluster is not None else TopicBus()
         self.store = JobStore(
             journal_dir=self.config.storage.journal_dir if journal else None
         )
-        self.cache = DatasetCache(root=self.config.storage.datasets_dir)
+        self.cache = (
+            cluster.cache
+            if cluster is not None and cluster.cache is not None
+            else DatasetCache(root=self.config.storage.datasets_dir)
+        )
+        if cluster is not None and cluster.cache is None:
+            cluster.cache = self.cache
+        # retained in cluster mode too: artifact refits run coordinator-side
         self.executor = executor or LocalExecutor(mesh=mesh, cache=self.cache)
         self._job_threads: Dict[str, threading.Thread] = {}
 
@@ -150,15 +163,50 @@ class Coordinator:
             self.bus.publish(TOPIC_METRICS, msg, key=msg.get("subtask_id"))
 
         try:
-            results = self.executor.run_subtasks(
-                subtasks, on_result=on_result, on_metrics=on_metrics
-            )
+            if self.cluster is not None:
+                results = self._run_job_scheduled(sid, job_id, subtasks, on_result)
+            else:
+                results = self.executor.run_subtasks(
+                    subtasks, on_result=on_result, on_metrics=on_metrics
+                )
             self._aggregate(sid, job_id, subtasks, results)
         except Exception as e:  # noqa: BLE001
             logger.exception("Job %s failed", job_id)
             self.store.finalize_job(
                 sid, job_id, {"status": "failed", "error": str(e)}
             )
+
+    def _run_job_scheduled(self, sid, job_id, subtasks, on_result) -> List[Dict[str, Any]]:
+        """Dispatch through the placement engine and collect results from the
+        bus — the reference's consume_results thread (task_handler.py:18-68)
+        with at-least-once dedup."""
+        import queue as _q
+
+        wanted = {st["subtask_id"]: i for i, st in enumerate(subtasks)}
+        results: List[Optional[Dict[str, Any]]] = [None] * len(subtasks)
+        sub = self.bus.subscribe("result", key_filter=lambda k: k in wanted)
+        try:
+            job = self.store.get_job(sid, job_id)
+            self.cluster.submit(subtasks, metadata=job.get("metadata") or None)
+            pending = set(wanted)
+            deadline = time.time() + self.config.service.client_timeout_s
+            while pending and time.time() < deadline:
+                try:
+                    stid, result = sub.get(timeout=0.5)
+                except _q.Empty:
+                    continue
+                if stid not in pending:
+                    continue  # duplicate delivery after a requeue
+                pending.discard(stid)
+                results[wanted[stid]] = result
+                on_result(stid, result.get("status", "completed"), result)
+            if pending:
+                raise TimeoutError(
+                    f"{len(pending)} subtasks never reported (e.g. {sorted(pending)[:3]})"
+                )
+            return results  # type: ignore[return-value]
+        finally:
+            sub.close()
 
     def _aggregate(self, sid, job_id, subtasks, results) -> None:
         """Sort completed trials by mean_cv_score desc; best_result first

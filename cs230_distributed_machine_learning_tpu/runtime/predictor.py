@@ -1,0 +1,127 @@
+"""Online-learned trial-runtime predictor.
+
+Capability parity with the reference scheduler's ``RuntimePredictor``
+(``aws-prod/scheduler/scheduler_service.py:40-84``): a
+GradientBoostingRegressor over 7 features [algo id hash, n_rows, n_cols,
+mem%, cpu%, metric value, size_mb], joblib-persisted across restarts,
+cold-started with a dummy fit, refit every ``refit_batch`` observed
+samples, with per-algorithm multipliers from config. Here the observations
+come from executor device timings instead of Kafka ``metrics`` messages,
+and a trial batch's predicted runtime feeds the placement score the same
+way the reference's did.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.config import get_config
+from ..utils.logging import get_logger
+
+logger = get_logger("tpuml.predictor")
+
+
+class RuntimePredictor:
+    N_FEATURES = 7
+
+    def __init__(
+        self,
+        model_path: Optional[str] = None,
+        refit_batch: Optional[int] = None,
+        algo_weights: Optional[Dict[str, float]] = None,
+    ):
+        cfg = get_config()
+        self.model_path = model_path or cfg.storage.runtime_model_path
+        self.refit_batch = refit_batch or cfg.scheduler.predictor_refit_batch
+        self.algo_weights = dict(algo_weights or cfg.scheduler.algo_weights)
+        self._lock = threading.Lock()
+        self._buffer: List[tuple] = []
+        self._model = self._load_or_init()
+
+    # ---------------- features ----------------
+
+    @staticmethod
+    def features(task: Dict[str, Any]) -> np.ndarray:
+        algo = task.get("model_type", "")
+        meta = task.get("metadata") or {}
+        return np.asarray(
+            [
+                hash(algo) % 1000,
+                float(meta.get("n_rows", 0) or 0),
+                float(meta.get("n_cols", 0) or 0),
+                float(task.get("mem_percent_avg", 0) or 0),
+                float(task.get("cpu_percent_avg", 0) or 0),
+                float(task.get("metric_value", 0) or 0),
+                float(meta.get("size_mb", 0) or 0),
+            ],
+            dtype=np.float64,
+        )
+
+    # ---------------- predict / observe ----------------
+
+    def predict(self, task: Dict[str, Any]) -> float:
+        feats = self.features(task)[None, :]
+        with self._lock:
+            est = float(self._model.predict(feats)[0])
+        est = max(est, 1e-3)
+        mult = self.algo_weights.get(task.get("model_type", ""), 1.0)
+        return est * mult
+
+    def observe(self, task: Dict[str, Any], actual_runtime_s: float) -> None:
+        feats = self.features(task)
+        with self._lock:
+            self._buffer.append((feats, float(actual_runtime_s)))
+            if len(self._buffer) < self.refit_batch:
+                return
+            batch, self._buffer = self._buffer, []
+        self._refit(batch)
+
+    def _refit(self, batch) -> None:
+        from sklearn.ensemble import GradientBoostingRegressor
+
+        X = np.stack([f for f, _ in batch])
+        y = np.asarray([t for _, t in batch])
+        with self._lock:
+            # accumulate by warm-refit on the union of a replay of recent data:
+            # GBRT has no partial_fit, so mirror the reference and refit on the
+            # latest batch (scheduler_service.py:72-84)
+            model = GradientBoostingRegressor(random_state=0)
+            try:
+                model.fit(X, y)
+                self._model = model
+                self._persist()
+            except Exception:  # noqa: BLE001
+                logger.exception("Runtime-predictor refit failed; keeping old model")
+
+    # ---------------- persistence ----------------
+
+    def _load_or_init(self):
+        from sklearn.ensemble import GradientBoostingRegressor
+
+        if self.model_path and os.path.exists(self.model_path):
+            try:
+                import joblib
+
+                return joblib.load(self.model_path)
+            except Exception:  # noqa: BLE001
+                logger.exception("Failed to load runtime model; cold-starting")
+        model = GradientBoostingRegressor(random_state=0)
+        # cold-start dummy fit so predict() works before observations arrive
+        Xd = np.zeros((2, self.N_FEATURES))
+        model.fit(Xd, np.asarray([1.0, 1.0]))
+        return model
+
+    def _persist(self) -> None:
+        if not self.model_path:
+            return
+        try:
+            import joblib
+
+            os.makedirs(os.path.dirname(self.model_path), exist_ok=True)
+            joblib.dump(self._model, self.model_path)
+        except Exception:  # noqa: BLE001
+            logger.exception("Failed to persist runtime model")

@@ -1,0 +1,249 @@
+"""Placement engine: learned-runtime, load/memory/speed-aware scheduling.
+
+Capability parity with the reference scheduler service
+(``aws-prod/scheduler/scheduler_service.py``), re-homed from Kafka-keyed
+containers to mesh executors:
+
+- ``WorkerState`` (scheduler_service.py:91-104): queued-runtime load,
+  memory load vs capacity, EMA speed factor, heartbeat stamp, task queue.
+- placement (scheduler_service.py:167-191): eligible = fits in memory
+  (fallback: all, with a warning); score = effective_finish_time +
+  est_runtime / max(speed, 1e-3); pick min.
+- feedback (scheduler_service.py:295-351): on a metrics message, decrement
+  load/memory, update ``speed_factor = clamp(0.2..5, 0.8*old +
+  0.2*(est/actual))``, feed the runtime predictor.
+- failure detection (scheduler_service.py:205-247): periodic sweep marks
+  workers dead after ``dead_after_s`` of heartbeat silence and requeues
+  their queued tasks onto survivors; ``unsubscribe`` does the same
+  gracefully (scheduler.py:120-139). Elastic join assigns monotonically
+  increasing ids (scheduler_service.py:157-165).
+
+The engine is transport-agnostic: it consumes/produces on the in-process
+TopicBus (runtime/queue.py) locally, and the same message schema rides DCN
+RPC for multi-host agents (runtime/agent.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.config import get_config
+from ..utils.logging import get_logger
+from .predictor import RuntimePredictor
+
+logger = get_logger("tpuml.scheduler")
+
+TOPIC_TASKS = "tasks"
+TOPIC_TRAIN = "train"
+
+
+@dataclasses.dataclass
+class WorkerState:
+    worker_id: str
+    mem_capacity_mb: float
+    load_seconds: float = 0.0
+    mem_load_mb: float = 0.0
+    speed_factor: float = 1.0
+    last_heartbeat: float = dataclasses.field(default_factory=time.time)
+    tasks_queue: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    # per-task bookkeeping for feedback decrements
+    task_est: Dict[str, float] = dataclasses.field(default_factory=dict)
+    task_mem: Dict[str, float] = dataclasses.field(default_factory=dict)
+    alive: bool = True
+
+    def effective_finish_time(self) -> float:
+        return self.load_seconds / max(self.speed_factor, 1e-3)
+
+
+class PlacementEngine:
+    def __init__(self, bus=None, predictor: Optional[RuntimePredictor] = None):
+        cfg = get_config().scheduler
+        self.cfg = cfg
+        self.bus = bus
+        self.predictor = predictor or RuntimePredictor()
+        self._lock = threading.RLock()
+        self.workers: Dict[str, WorkerState] = {}
+        self._next_id = 0
+        self._stop = threading.Event()
+        self._monitor_thread: Optional[threading.Thread] = None
+
+    # ---------------- registry (subscribe/heartbeat/unsubscribe) ----------------
+
+    def subscribe(self, mem_capacity_mb: Optional[float] = None, worker_id: Optional[str] = None) -> str:
+        with self._lock:
+            if worker_id is None:
+                worker_id = f"worker-{self._next_id}"
+                self._next_id += 1
+            self.workers[worker_id] = WorkerState(
+                worker_id=worker_id,
+                mem_capacity_mb=mem_capacity_mb or self.cfg.default_mem_capacity_mb,
+            )
+            logger.info("Worker %s subscribed", worker_id)
+            return worker_id
+
+    def unsubscribe(self, worker_id: str) -> List[Dict[str, Any]]:
+        """Remove a worker; requeue its queued tasks. Returns the requeued tasks."""
+        with self._lock:
+            state = self.workers.pop(worker_id, None)
+        if state is None:
+            return []
+        logger.info("Worker %s unsubscribed; requeueing %d tasks", worker_id, len(state.tasks_queue))
+        return self._requeue(state.tasks_queue)
+
+    def heartbeat(self, worker_id: str) -> bool:
+        with self._lock:
+            state = self.workers.get(worker_id)
+            if state is None:
+                return False
+            state.last_heartbeat = time.time()
+            return True
+
+    def worker_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                wid: {
+                    "load_seconds": w.load_seconds,
+                    "mem_load_mb": w.mem_load_mb,
+                    "mem_capacity_mb": w.mem_capacity_mb,
+                    "speed_factor": w.speed_factor,
+                    "last_heartbeat": w.last_heartbeat,
+                    "queue_depth": len(w.tasks_queue),
+                }
+                for wid, w in self.workers.items()
+            }
+
+    def queue_snapshot(self) -> Dict[str, List[str]]:
+        with self._lock:
+            return {
+                wid: [t.get("subtask_id", "?") for t in w.tasks_queue]
+                for wid, w in self.workers.items()
+            }
+
+    # ---------------- placement ----------------
+
+    def place(self, task: Dict[str, Any]) -> Optional[str]:
+        """Choose a worker for a task, update its load, and (when a bus is
+        wired) publish to the train topic keyed by worker id. Returns the
+        worker id, or None if no workers exist."""
+        est = self.predictor.predict(task)
+        mem_mb = float(task.get("mem_estimate_mb", 1.0))
+        with self._lock:
+            if not self.workers:
+                return None
+            eligible = [
+                w
+                for w in self.workers.values()
+                if w.mem_load_mb + mem_mb <= w.mem_capacity_mb
+            ]
+            if not eligible:
+                logger.warning(
+                    "No worker fits task %s (%.0f MB); falling back to all",
+                    task.get("subtask_id"),
+                    mem_mb,
+                )
+                eligible = list(self.workers.values())
+            best = min(
+                eligible,
+                key=lambda w: w.effective_finish_time() + est / max(w.speed_factor, 1e-3),
+            )
+            best.load_seconds += est
+            best.mem_load_mb += mem_mb
+            best.tasks_queue.append(task)
+            stid = task.get("subtask_id")
+            best.task_est[stid] = est
+            best.task_mem[stid] = mem_mb
+            wid = best.worker_id
+        if self.bus is not None:
+            self.bus.publish(TOPIC_TRAIN, task, key=wid)
+        return wid
+
+    # ---------------- feedback ----------------
+
+    def on_metrics(self, msg: Dict[str, Any]) -> None:
+        """Consume a worker metrics message (schema: worker.py:233-243)."""
+        wid = msg.get("worker_id")
+        stid = msg.get("subtask_id")
+        started = msg.get("started_at")
+        finished = msg.get("finished_at")
+        actual = None
+        if started is not None and finished is not None:
+            actual = max(float(finished) - float(started), 1e-3)
+        with self._lock:
+            w = self.workers.get(wid)
+            if w is None:
+                return
+            est = w.task_est.pop(stid, 0.0)
+            mem = w.task_mem.pop(stid, 0.0)
+            w.load_seconds = max(0.0, w.load_seconds - est)
+            w.mem_load_mb = max(0.0, w.mem_load_mb - mem)
+            w.tasks_queue = [t for t in w.tasks_queue if t.get("subtask_id") != stid]
+            if actual is not None and est > 0:
+                ratio = est / actual
+                w.speed_factor = min(
+                    self.cfg.speed_factor_max,
+                    max(
+                        self.cfg.speed_factor_min,
+                        (1 - self.cfg.speed_ema_alpha) * w.speed_factor
+                        + self.cfg.speed_ema_alpha * ratio,
+                    ),
+                )
+        if actual is not None:
+            self.predictor.observe(msg, actual)
+
+    # ---------------- failure detection ----------------
+
+    def start_monitor(self) -> None:
+        if self._monitor_thread is not None:
+            return
+        self._stop.clear()
+        self._monitor_thread = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor_thread.start()
+
+    def stop_monitor(self) -> None:
+        self._stop.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=2)
+            self._monitor_thread = None
+
+    def sweep(self) -> List[str]:
+        """One failure-detection pass; returns ids of workers declared dead."""
+        now = time.time()
+        dead: List[WorkerState] = []
+        with self._lock:
+            for wid, w in list(self.workers.items()):
+                if now - w.last_heartbeat > self.cfg.dead_after_s:
+                    dead.append(self.workers.pop(wid))
+        for w in dead:
+            logger.warning(
+                "Worker %s dead (no heartbeat for >%ss); requeueing %d tasks",
+                w.worker_id,
+                self.cfg.dead_after_s,
+                len(w.tasks_queue),
+            )
+            self._requeue(w.tasks_queue)
+        return [w.worker_id for w in dead]
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self.cfg.sweep_interval_s):
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001
+                logger.exception("Heartbeat sweep failed")
+
+    def _requeue(self, tasks: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        requeued = []
+        for task in tasks:
+            wid = self.place(task)
+            if wid is None:
+                logger.error(
+                    "No surviving worker for %s; task dropped back to tasks topic",
+                    task.get("subtask_id"),
+                )
+                if self.bus is not None:
+                    self.bus.publish(TOPIC_TASKS, task)
+            else:
+                requeued.append(task)
+        return requeued

@@ -1,0 +1,164 @@
+"""Coordinator REST server: wire-compatible with the reference master.
+
+Route parity with ``aws-prod/master/master.py:27-390`` (same paths, methods,
+and response shapes — the home route enumerates them like master.py:30-44),
+plus the reference scheduler's introspection endpoints (/workers, /queues —
+scheduler.py:95-97,154-159) served from the placement engine when the
+coordinator runs a cluster. SSE progress streaming (/train_status) keeps the
+reference's event schema {job_status, tasks_pending, total_subtasks} with a
+final event carrying job_result (master.py:237-266).
+
+Built as a plain WSGI app on werkzeug (no Flask dependency): same
+deployment surface, serve with ``serve()`` or any WSGI server.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..utils.serialization import json_safe
+from .coordinator import Coordinator
+
+
+def create_app(coordinator: Optional[Coordinator] = None):
+    from werkzeug.exceptions import HTTPException, NotFound
+    from werkzeug.routing import Map, Rule
+    from werkzeug.wrappers import Request, Response
+
+    coord = coordinator or Coordinator()
+
+    url_map = Map(
+        [
+            Rule("/", endpoint="home", methods=["GET"]),
+            Rule("/health", endpoint="health", methods=["GET"]),
+            Rule("/create_session", endpoint="create_session", methods=["POST"]),
+            Rule("/download_data/<sid>", endpoint="download_data", methods=["POST"]),
+            Rule("/check_data/<sid>", endpoint="check_data", methods=["GET"]),
+            Rule("/preprocess/<sid>", endpoint="preprocess", methods=["POST"]),
+            Rule("/train/<sid>", endpoint="train", methods=["POST"]),
+            Rule("/train_status/<sid>", endpoint="train_status", methods=["POST"]),
+            Rule("/check_status/<sid>/<jid>", endpoint="check_status", methods=["GET"]),
+            Rule("/metrics/<sid>/<jid>", endpoint="metrics", methods=["GET"]),
+            Rule("/download_model/<sid>/<jid>", endpoint="download_model", methods=["GET"]),
+            Rule("/workers", endpoint="workers", methods=["GET"]),
+            Rule("/queues", endpoint="queues", methods=["GET"]),
+        ]
+    )
+
+    def _json(data, status=200):
+        return Response(
+            json.dumps(json_safe(data)), status=status, mimetype="application/json"
+        )
+
+    def home(request):
+        return _json(
+            {
+                "service": "tpuml-coordinator",
+                "endpoints": [
+                    "POST /create_session",
+                    "POST /download_data/<session_id>",
+                    "GET  /check_data/<session_id>?dataset_name=",
+                    "POST /preprocess/<session_id>",
+                    "POST /train/<session_id>",
+                    "POST /train_status/<session_id>  (SSE)",
+                    "GET  /check_status/<session_id>/<job_id>",
+                    "GET  /metrics/<session_id>/<job_id>",
+                    "GET  /download_model/<session_id>/<job_id>",
+                    "GET  /workers",
+                    "GET  /queues",
+                    "GET  /health",
+                ],
+            }
+        )
+
+    def health(request):
+        return _json({"status": "ok"})
+
+    def create_session(request):
+        return _json({"session_id": coord.create_session()}, status=201)
+
+    def download_data(request, sid):
+        body = request.get_json(force=True)
+        return _json(
+            coord.download_data(
+                sid, body["dataset_url"], body["dataset_name"], body["dataset_type"]
+            )
+        )
+
+    def check_data(request, sid):
+        return _json(coord.check_data(sid, request.args["dataset_name"]))
+
+    def preprocess(request, sid):
+        body = request.get_json(force=True)
+        return _json(coord.preprocess(sid, body["dataset_id"], body.get("config")))
+
+    def train(request, sid):
+        return _json(coord.submit_train(sid, request.get_json(force=True)))
+
+    def train_status(request, sid):
+        submit = coord.submit_train(sid, request.get_json(force=True))
+        job_id = submit["job_id"]
+
+        def stream():
+            for progress in coord.stream_status(sid, job_id):
+                yield f"data: {json.dumps(json_safe(progress))}\n\n"
+
+        return Response(stream(), mimetype="text/event-stream")
+
+    def check_status(request, sid, jid):
+        return _json(coord.check_status(sid, jid))
+
+    def metrics(request, sid, jid):
+        return _json(coord.job_metrics(sid, jid))
+
+    def download_model(request, sid, jid):
+        path = coord.best_model_path(sid, jid)
+        if path is None:
+            return _json({"status": "error", "message": "no model artifact"}, status=404)
+        with open(path, "rb") as f:
+            payload = f.read()
+        return Response(
+            payload,
+            mimetype="application/octet-stream",
+            headers={"Content-Disposition": f"attachment; filename={jid}_best_model.pkl"},
+        )
+
+    def workers(request):
+        if coord.cluster is None:
+            return _json({})
+        return _json(coord.cluster.engine.worker_snapshot())
+
+    def queues(request):
+        if coord.cluster is None:
+            return _json({})
+        return _json(coord.cluster.engine.queue_snapshot())
+
+    handlers = locals()
+
+    @Request.application
+    def app(request):
+        try:
+            endpoint, values = url_map.bind_to_environ(request.environ).match()
+            return handlers[endpoint](request, **values)
+        except NotFound:
+            return _json({"status": "error", "message": "not found"}, status=404)
+        except HTTPException as e:
+            return _json({"status": "error", "message": str(e)}, status=e.code or 500)
+        except (KeyError, FileNotFoundError) as e:
+            return _json({"status": "error", "message": str(e)}, status=404)
+        except Exception as e:  # noqa: BLE001
+            return _json({"status": "error", "message": str(e)}, status=500)
+
+    app.coordinator = coord
+    return app
+
+
+def serve(coordinator: Optional[Coordinator] = None, host: Optional[str] = None, port: Optional[int] = None):
+    from werkzeug.serving import run_simple
+
+    from ..utils.config import get_config
+
+    cfg = get_config().service
+    app = create_app(coordinator)
+    run_simple(host or cfg.host, port or cfg.port, app, threaded=True)

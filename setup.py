@@ -18,6 +18,6 @@ setup(
     ],
     extras_require={
         "client": ["requests", "tqdm"],
-        "server": ["flask"],
+        "server": ["werkzeug"],
     },
 )

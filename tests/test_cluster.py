@@ -1,0 +1,87 @@
+"""Cluster runtime: scheduled dispatch, elastic recovery, fault injection."""
+
+import time
+
+import pytest
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+
+@pytest.fixture()
+def fast_cfg():
+    cfg = get_config()
+    cfg.scheduler.heartbeat_interval_s = 0.05
+    cfg.scheduler.dead_after_s = 0.5
+    cfg.scheduler.sweep_interval_s = 0.1
+    return cfg
+
+
+def test_scheduled_job_completes_across_two_executors(fast_cfg):
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(
+            GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.01, 0.1, 1.0, 10.0]}, cv=3),
+            "iris",
+            show_progress=False,
+        )
+        assert status["job_status"] == "completed"
+        assert len(status["job_result"]["results"]) == 4
+    finally:
+        cluster.shutdown()
+
+
+def test_killed_executor_tasks_requeue_to_survivor(fast_cfg):
+    cluster = ClusterRuntime()
+    try:
+        # a worker that is subscribed but never consumes: tasks pile up on it
+        stuck_wid = cluster.engine.subscribe()
+        live_wid = cluster.add_executor()
+
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        # submit async; some subtasks will be placed on the stuck worker
+        submit = m.train(
+            GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.01, 0.1, 1.0, 10.0]}, cv=3),
+            "iris",
+            wait_for_completion=False,
+            show_progress=False,
+        )
+        # keep the live worker heartbeating; the stuck one goes silent and the
+        # sweep requeues its tasks onto the live executor
+        status = coord.wait_for_completion(m.session_id, submit["job_id"], timeout_s=30)
+        assert status["job_status"] == "completed"
+        assert status["job_result"]["best_result"] is not None
+        # dead worker is gone from the registry
+        assert stuck_wid not in cluster.engine.worker_snapshot()
+        assert live_wid in cluster.engine.worker_snapshot()
+    finally:
+        cluster.shutdown()
+
+
+def test_elastic_join_mid_stream(fast_cfg):
+    cluster = ClusterRuntime()
+    try:
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        submit = m.train(
+            LogisticRegression(max_iter=300),
+            "iris",
+            wait_for_completion=False,
+            show_progress=False,
+        )
+        # no executors yet: the task parks on the tasks topic; join later
+        time.sleep(0.3)
+        cluster.add_executor()
+        status = coord.wait_for_completion(m.session_id, submit["job_id"], timeout_s=30)
+        assert status["job_status"] == "completed"
+    finally:
+        cluster.shutdown()

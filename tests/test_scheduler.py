@@ -1,0 +1,156 @@
+"""Placement-engine state machine: scoring, feedback, failure detection."""
+
+import time
+
+import pytest
+
+from cs230_distributed_machine_learning_tpu.runtime.predictor import RuntimePredictor
+from cs230_distributed_machine_learning_tpu.runtime.queue import TopicBus
+from cs230_distributed_machine_learning_tpu.runtime.scheduler import PlacementEngine
+
+
+class FixedPredictor(RuntimePredictor):
+    """Deterministic predictor for state-machine tests."""
+
+    def __init__(self, est=10.0):
+        self.est = est
+        self.observed = []
+        self.algo_weights = {}
+
+    def predict(self, task):
+        return self.est
+
+    def observe(self, task, actual):
+        self.observed.append((task.get("subtask_id"), actual))
+
+
+def _task(stid, mem=1.0):
+    return {"subtask_id": stid, "model_type": "LogisticRegression", "mem_estimate_mb": mem}
+
+
+def test_ids_are_monotonic_and_elastic():
+    eng = PlacementEngine(predictor=FixedPredictor())
+    w0 = eng.subscribe()
+    w1 = eng.subscribe()
+    assert (w0, w1) == ("worker-0", "worker-1")
+    eng.unsubscribe(w0)
+    w2 = eng.subscribe()
+    assert w2 == "worker-2"  # ids never reused (scheduler_service.py:157-165)
+
+
+def test_placement_balances_load():
+    eng = PlacementEngine(predictor=FixedPredictor(est=5.0))
+    eng.subscribe()
+    eng.subscribe()
+    placements = [eng.place(_task(f"t{i}")) for i in range(4)]
+    # equal workers, equal tasks -> round-robin-like balance 2/2
+    assert sorted(placements) == ["worker-0", "worker-0", "worker-1", "worker-1"]
+    snap = eng.worker_snapshot()
+    assert snap["worker-0"]["load_seconds"] == snap["worker-1"]["load_seconds"] == 10.0
+
+
+def test_memory_gate_and_fallback():
+    eng = PlacementEngine(predictor=FixedPredictor())
+    eng.subscribe(mem_capacity_mb=10.0)
+    eng.subscribe(mem_capacity_mb=1000.0)
+    # 100 MB task only fits worker-1
+    assert eng.place(_task("big", mem=100.0)) == "worker-1"
+    # a task too big for anyone falls back to least-loaded rather than stalling
+    assert eng.place(_task("huge", mem=10_000.0)) in ("worker-0", "worker-1")
+
+
+def test_speed_ema_feedback_prefers_fast_worker():
+    eng = PlacementEngine(predictor=FixedPredictor(est=10.0))
+    eng.subscribe()
+    eng.subscribe()
+    eng.place(_task("a"))  # -> worker-0
+    eng.place(_task("b"))  # -> worker-1
+    now = time.time()
+    # worker-0 finished 5x faster than estimated; worker-1 5x slower
+    eng.on_metrics({"worker_id": "worker-0", "subtask_id": "a",
+                    "started_at": now, "finished_at": now + 2.0})
+    eng.on_metrics({"worker_id": "worker-1", "subtask_id": "b",
+                    "started_at": now, "finished_at": now + 50.0})
+    snap = eng.worker_snapshot()
+    assert snap["worker-0"]["speed_factor"] > 1.0 > snap["worker-1"]["speed_factor"]
+    assert snap["worker-0"]["load_seconds"] == 0.0
+    # next placements should all prefer the fast worker until load evens out
+    assert eng.place(_task("c")) == "worker-0"
+
+
+def test_speed_factor_clamped():
+    eng = PlacementEngine(predictor=FixedPredictor(est=1000.0))
+    eng.subscribe()
+    now = time.time()
+    for i in range(50):
+        eng.place(_task(f"t{i}"))
+        eng.on_metrics({"worker_id": "worker-0", "subtask_id": f"t{i}",
+                        "started_at": now, "finished_at": now + 0.001})
+    assert eng.worker_snapshot()["worker-0"]["speed_factor"] <= 5.0
+
+
+def test_dead_worker_requeued_to_survivor(monkeypatch):
+    from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+    get_config().scheduler.dead_after_s = 0.05
+    bus = TopicBus()
+    eng = PlacementEngine(bus=bus, predictor=FixedPredictor())
+    eng.subscribe()
+    eng.subscribe()
+    train_sub = bus.subscribe("train")
+    placed = eng.place(_task("t0"))
+    survivor = "worker-1" if placed == "worker-0" else "worker-0"
+    # only the survivor heartbeats
+    time.sleep(0.1)
+    eng.heartbeat(survivor)
+    dead = eng.sweep()
+    assert dead == [placed]
+    # the task was re-placed onto the survivor and republished keyed to it
+    keys = []
+    while len(train_sub):
+        k, _ = train_sub.get_nowait()
+        keys.append(k)
+    assert keys == [placed, survivor]
+    assert eng.queue_snapshot()[survivor] == ["t0"]
+
+
+def test_unsubscribe_requeues():
+    eng = PlacementEngine(predictor=FixedPredictor())
+    eng.subscribe()
+    eng.subscribe()
+    target = eng.place(_task("t0"))
+    other = "worker-1" if target == "worker-0" else "worker-0"
+    requeued = eng.unsubscribe(target)
+    assert [t["subtask_id"] for t in requeued] == ["t0"]
+    assert eng.queue_snapshot()[other] == ["t0"]
+
+
+def test_predictor_receives_observations():
+    pred = FixedPredictor(est=3.0)
+    eng = PlacementEngine(predictor=pred)
+    eng.subscribe()
+    eng.place(_task("t0"))
+    now = time.time()
+    eng.on_metrics({"worker_id": "worker-0", "subtask_id": "t0",
+                    "started_at": now, "finished_at": now + 1.5})
+    assert pred.observed and abs(pred.observed[0][1] - 1.5) < 1e-6
+
+
+def test_real_predictor_learns_and_persists(tmp_path):
+    path = str(tmp_path / "rt.joblib")
+    pred = RuntimePredictor(model_path=path, refit_batch=5)
+    task = {"model_type": "SVC", "metadata": {"n_rows": 1000, "n_cols": 10, "size_mb": 1.0}}
+    for _ in range(5):
+        pred.observe(task, 7.0)
+    est = pred.predict(task)
+    assert 5.0 < est < 9.0  # learned roughly the observed runtime
+    # persisted model reloads
+    pred2 = RuntimePredictor(model_path=path, refit_batch=5)
+    assert 5.0 < pred2.predict(task) < 9.0
+
+
+def test_algo_weight_multiplier():
+    pred = RuntimePredictor(model_path=None, algo_weights={"xgboost": 1.3})
+    base = pred.predict({"model_type": "other"})
+    weighted = pred.predict({"model_type": "xgboost"})
+    assert abs(weighted - base * 1.3) < 1e-9

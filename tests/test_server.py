@@ -1,0 +1,122 @@
+"""REST surface: route parity with the reference master (werkzeug test client)."""
+
+import json
+
+import pytest
+from sklearn.linear_model import LogisticRegression
+
+from cs230_distributed_machine_learning_tpu.client.introspection import (
+    extract_model_details,
+)
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+
+
+@pytest.fixture()
+def client():
+    from werkzeug.test import Client
+
+    return Client(create_app(Coordinator()))
+
+
+def _session(client):
+    resp = client.post("/create_session")
+    assert resp.status_code == 201
+    return resp.get_json()["session_id"]
+
+
+def _train_payload(sid):
+    return {
+        "session_id": sid,
+        "dataset_id": "iris",
+        "model_details": extract_model_details(LogisticRegression(max_iter=300)),
+        "train_params": {"test_size": 0.2, "random_state": 0},
+    }
+
+
+def test_home_enumerates_routes(client):
+    body = client.get("/").get_json()
+    assert any("/train_status" in e for e in body["endpoints"])
+    assert client.get("/health").get_json()["status"] == "ok"
+
+
+def test_full_rest_train_flow(client):
+    sid = _session(client)
+    # check_data on a builtin stages lazily -> initially absent is fine
+    resp = client.get(f"/check_data/{sid}", query_string={"dataset_name": "iris"})
+    assert resp.status_code == 200
+
+    resp = client.post(f"/train/{sid}", json=_train_payload(sid))
+    assert resp.status_code == 200
+    jid = resp.get_json()["job_id"]
+
+    # poll until complete
+    import time
+
+    for _ in range(200):
+        status = client.get(f"/check_status/{sid}/{jid}").get_json()
+        if status["job_status"] in ("completed", "failed"):
+            break
+        time.sleep(0.1)
+    assert status["job_status"] == "completed"
+    assert status["job_result"]["best_result"]["accuracy"] > 0.8
+
+    metrics = client.get(f"/metrics/{sid}/{jid}").get_json()
+    assert len(metrics) == 1 and metrics[0]["status"] == "completed"
+
+    dl = client.get(f"/download_model/{sid}/{jid}")
+    assert dl.status_code == 200
+    assert len(dl.data) > 100  # a real pickle payload
+
+
+def test_sse_stream_emits_progress_and_completes(client):
+    sid = _session(client)
+    resp = client.post(f"/train_status/{sid}", json=_train_payload(sid))
+    assert resp.status_code == 200
+    assert resp.mimetype == "text/event-stream"
+    events = []
+    for chunk in resp.response:
+        text = chunk.decode() if isinstance(chunk, bytes) else chunk
+        for line in text.strip().splitlines():
+            if line.startswith("data: "):
+                events.append(json.loads(line[6:]))
+    assert events, "no SSE events received"
+    assert events[-1]["job_status"] in ("completed", "failed")
+    assert events[-1]["job_result"] is not None
+
+
+def test_invalid_session_404(client):
+    resp = client.get("/check_status/bogus/alsobogus")
+    assert resp.status_code == 404
+
+
+def test_preprocess_endpoint(client, tmp_path):
+    import pandas as pd
+
+    sid = _session(client)
+    src = tmp_path / "raw.csv"
+    pd.DataFrame(
+        {"a": [1.0, 2.0, None, 4.0], "b": ["x", "y", "x", "z"], "t": [0, 1, 0, 1]}
+    ).to_csv(src, index=False)
+    resp = client.post(
+        f"/download_data/{sid}",
+        json={"dataset_url": str(src), "dataset_name": "mini", "dataset_type": "local"},
+    )
+    assert resp.status_code == 200
+    resp = client.post(
+        f"/preprocess/{sid}",
+        json={
+            "dataset_id": "mini",
+            "config": {
+                "impute": {"a": "mean"},
+                "categorical": {"b": "onehot"},
+                "target_column": "t",
+            },
+        },
+    )
+    assert resp.status_code == 200
+    body = resp.get_json()
+    assert body["status"] == "success"
+    df = pd.read_csv(body["preprocessed_path"])
+    assert list(df.columns)[-1] == "t"
+    assert not df["a"].isna().any()
