@@ -1,0 +1,185 @@
+"""Worker agent: a per-host executor process on the DCN control plane.
+
+Capability parity with the reference worker's lifecycle
+(``aws-prod/worker/worker.py:90-286``): on start, register with the
+coordinator over REST (retry loop -> worker_id); heartbeat in a daemon
+thread; consume the keyed task stream; run trial batches on the local
+mesh; report results and metrics; unsubscribe on shutdown so queued tasks
+requeue gracefully. Where the reference worker consumed a keyed Kafka
+topic, the agent long-polls ``GET /next_tasks/<wid>`` — the coordinator
+holds its keyed queue (runtime/cluster.py register_remote) — so no broker
+exists anywhere.
+
+Multi-host TPU deployment model (SURVEY.md §5.8): one agent per TPU-VM
+host, each owning its host's chips as a local mesh; dataset staging is
+per-host (the agent's DatasetCache stages builtins/local CSVs itself —
+replacing the reference's shared EFS volume with host-local staging, with
+arrays living in HBM across trials). For pod-slice SPMD *within* a job, the
+agent can be launched under ``jax.distributed.initialize`` so its mesh
+spans hosts; the control plane here is orthogonal to that data plane.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..utils.config import get_config
+from ..utils.logging import get_logger
+from ..utils.serialization import json_safe
+from .executor import LocalExecutor
+
+logger = get_logger("tpuml.agent")
+
+
+class WorkerAgent:
+    def __init__(
+        self,
+        coordinator_url: str,
+        *,
+        mesh=None,
+        mem_capacity_mb: Optional[float] = None,
+        poll_timeout_s: float = 5.0,
+        max_batch: Optional[int] = None,
+        register_retries: int = 10,
+        register_backoff_s: float = 5.0,
+    ):
+        self.url = coordinator_url.rstrip("/")
+        self.poll_timeout_s = poll_timeout_s
+        self._stop = threading.Event()
+        self.worker_id = self._register(mem_capacity_mb, register_retries, register_backoff_s)
+        self.executor = LocalExecutor(executor_id=self.worker_id, mesh=mesh)
+        if max_batch:
+            self.executor.max_trials_per_batch = max_batch
+        self._threads: List[threading.Thread] = []
+
+    # ---------------- lifecycle ----------------
+
+    def _register(self, mem_capacity_mb, retries: int, backoff_s: float) -> str:
+        import requests
+
+        last_err: Optional[Exception] = None
+        for attempt in range(retries):
+            try:
+                resp = requests.post(
+                    f"{self.url}/subscribe",
+                    json={"mem_capacity_mb": mem_capacity_mb},
+                    timeout=10,
+                )
+                resp.raise_for_status()
+                wid = resp.json()["worker_id"]
+                logger.info("Registered with coordinator as %s", wid)
+                return wid
+            except Exception as e:  # noqa: BLE001
+                last_err = e
+                logger.warning("Registration attempt %d failed: %s", attempt + 1, e)
+                time.sleep(backoff_s)
+        raise ConnectionError(f"Could not register with {self.url}: {last_err}")
+
+    def start(self) -> None:
+        for target in (self._run_loop, self._heartbeat_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self, unsubscribe: bool = True) -> None:
+        self._stop.set()
+        if unsubscribe:
+            try:
+                import requests
+
+                requests.post(f"{self.url}/unsubscribe/{self.worker_id}", timeout=10)
+            except Exception:  # noqa: BLE001
+                logger.exception("Unsubscribe failed")
+        for t in self._threads:
+            t.join(timeout=self.poll_timeout_s + 2)
+
+    def run_forever(self) -> None:
+        self.start()
+        try:
+            while not self._stop.wait(1.0):
+                pass
+        except KeyboardInterrupt:
+            self.stop()
+
+    # ---------------- loops ----------------
+
+    def _heartbeat_loop(self) -> None:
+        import requests
+
+        interval = get_config().scheduler.heartbeat_interval_s
+        while not self._stop.wait(interval):
+            try:
+                requests.post(f"{self.url}/heartbeat/{self.worker_id}", timeout=10)
+            except Exception:  # noqa: BLE001
+                logger.warning("Heartbeat to %s failed", self.url)
+
+    def _run_loop(self) -> None:
+        import requests
+
+        while not self._stop.is_set():
+            try:
+                resp = requests.get(
+                    f"{self.url}/next_tasks/{self.worker_id}",
+                    params={
+                        "max": self.executor.max_trials_per_batch,
+                        "timeout": self.poll_timeout_s,
+                    },
+                    timeout=self.poll_timeout_s + 10,
+                )
+                resp.raise_for_status()
+                tasks: List[Dict[str, Any]] = resp.json().get("tasks", [])
+            except Exception:  # noqa: BLE001
+                logger.exception("Task poll failed; backing off")
+                time.sleep(1.0)
+                continue
+            if not tasks:
+                continue
+            self.executor.run_subtasks(
+                tasks,
+                on_result=self._post_result,
+                on_metrics=self._post_metrics,
+            )
+
+    def _post_result(self, stid: str, status: str, result: Optional[Dict[str, Any]]) -> None:
+        import requests
+
+        try:
+            requests.post(
+                f"{self.url}/task_result/{self.worker_id}",
+                json=json_safe(result),
+                timeout=30,
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("Result post failed for %s", stid)
+
+    def _post_metrics(self, msg: Dict[str, Any]) -> None:
+        import requests
+
+        try:
+            requests.post(
+                f"{self.url}/task_metrics/{self.worker_id}",
+                json=json_safe(msg),
+                timeout=30,
+            )
+        except Exception:  # noqa: BLE001
+            logger.exception("Metrics post failed")
+
+
+def main() -> None:
+    """CLI: ``python -m cs230_distributed_machine_learning_tpu.runtime.agent
+    --url http://coordinator:5001`` (one per TPU-VM host)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="tpuml worker agent")
+    parser.add_argument("--url", required=True, help="coordinator base URL")
+    parser.add_argument("--mem-mb", type=float, default=None)
+    parser.add_argument("--max-batch", type=int, default=None)
+    args = parser.parse_args()
+    agent = WorkerAgent(args.url, mem_capacity_mb=args.mem_mb, max_batch=args.max_batch)
+    agent.run_forever()
+
+
+if __name__ == "__main__":
+    main()

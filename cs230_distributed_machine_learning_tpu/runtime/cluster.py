@@ -110,6 +110,7 @@ class ClusterRuntime:
         self.engine = PlacementEngine(bus=self.bus, predictor=predictor)
         self.cache = cache
         self.workers: Dict[str, ExecutorWorker] = {}
+        self._remote_subs: Dict[str, Any] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         for target in (self._ingress_loop, self._metrics_loop):
@@ -141,6 +142,51 @@ class ClusterRuntime:
         worker = self.workers.pop(worker_id, None)
         if worker is not None:
             worker.kill()
+
+    # ---------------- remote agents (DCN control plane) ----------------
+    # A remote WorkerAgent (runtime/agent.py) on another host registers here
+    # over REST and long-polls its keyed train queue — the HTTP analog of the
+    # reference worker's /subscribe + keyed Kafka consumption
+    # (worker.py:90-112, 185-186).
+
+    def register_remote(self, mem_capacity_mb: Optional[float] = None) -> str:
+        wid = self.engine.subscribe(mem_capacity_mb=mem_capacity_mb)
+        self._remote_subs[wid] = self.bus.subscribe(
+            TOPIC_TRAIN, key_filter=lambda k, w=wid: k == w
+        )
+        return wid
+
+    def unregister_remote(self, worker_id: str) -> None:
+        sub = self._remote_subs.pop(worker_id, None)
+        if sub is not None:
+            sub.close()
+        self.engine.unsubscribe(worker_id)
+
+    def pull_tasks(self, worker_id: str, max_n: int = 64, timeout_s: float = 10.0) -> List[Dict[str, Any]]:
+        """Long-poll the worker's train queue: blocks up to timeout for the
+        first task, then drains without blocking."""
+        sub = self._remote_subs.get(worker_id)
+        if sub is None:
+            raise KeyError(f"Unknown remote worker {worker_id}")
+        tasks: List[Dict[str, Any]] = []
+        try:
+            tasks.append(sub.get(timeout=timeout_s)[1])
+        except _queue.Empty:
+            return tasks
+        while len(tasks) < max_n:
+            try:
+                tasks.append(sub.get_nowait()[1])
+            except _queue.Empty:
+                break
+        return tasks
+
+    def push_result(self, worker_id: str, result: Dict[str, Any]) -> None:
+        self.bus.publish(TOPIC_RESULT, result, key=result.get("subtask_id"))
+
+    def push_metrics(self, worker_id: str, msg: Dict[str, Any]) -> None:
+        self.bus.publish(
+            TOPIC_METRICS, {**msg, "worker_id": worker_id}, key=msg.get("subtask_id")
+        )
 
     # ---------------- job submission ----------------
 

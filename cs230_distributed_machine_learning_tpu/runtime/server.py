@@ -43,6 +43,13 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/download_model/<sid>/<jid>", endpoint="download_model", methods=["GET"]),
             Rule("/workers", endpoint="workers", methods=["GET"]),
             Rule("/queues", endpoint="queues", methods=["GET"]),
+            # worker-agent control plane (reference scheduler.py:95-159)
+            Rule("/subscribe", endpoint="subscribe", methods=["POST"]),
+            Rule("/unsubscribe/<wid>", endpoint="unsubscribe", methods=["POST"]),
+            Rule("/heartbeat/<wid>", endpoint="heartbeat", methods=["POST"]),
+            Rule("/next_tasks/<wid>", endpoint="next_tasks", methods=["GET"]),
+            Rule("/task_result/<wid>", endpoint="task_result", methods=["POST"]),
+            Rule("/task_metrics/<wid>", endpoint="task_metrics", methods=["POST"]),
         ]
     )
 
@@ -133,6 +140,40 @@ def create_app(coordinator: Optional[Coordinator] = None):
         if coord.cluster is None:
             return _json({})
         return _json(coord.cluster.engine.queue_snapshot())
+
+    def _cluster_or_400():
+        if coord.cluster is None:
+            from werkzeug.exceptions import BadRequest
+
+            raise BadRequest("coordinator is not running a cluster")
+        return coord.cluster
+
+    def subscribe(request):
+        body = request.get_json(silent=True) or {}
+        wid = _cluster_or_400().register_remote(body.get("mem_capacity_mb"))
+        return _json({"worker_id": wid}, status=201)
+
+    def unsubscribe(request, wid):
+        _cluster_or_400().unregister_remote(wid)
+        return _json({"status": "ok"})
+
+    def heartbeat(request, wid):
+        ok = _cluster_or_400().engine.heartbeat(wid)
+        return _json({"status": "ok" if ok else "unknown_worker"}, status=200 if ok else 404)
+
+    def next_tasks(request, wid):
+        cluster = _cluster_or_400()
+        max_n = int(request.args.get("max", 64))
+        timeout_s = float(request.args.get("timeout", 10.0))
+        return _json({"tasks": cluster.pull_tasks(wid, max_n, timeout_s)})
+
+    def task_result(request, wid):
+        _cluster_or_400().push_result(wid, request.get_json(force=True))
+        return _json({"status": "ok"})
+
+    def task_metrics(request, wid):
+        _cluster_or_400().push_metrics(wid, request.get_json(force=True))
+        return _json({"status": "ok"})
 
     handlers = locals()
 

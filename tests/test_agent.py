@@ -1,0 +1,72 @@
+"""Remote worker agent over real HTTP: the DCN control-plane path."""
+
+import threading
+import time
+
+import pytest
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.runtime.agent import WorkerAgent
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+
+@pytest.fixture()
+def http_coordinator():
+    """Coordinator + cluster served over a real socket."""
+    from werkzeug.serving import make_server
+
+    get_config().scheduler.heartbeat_interval_s = 0.1
+    cluster = ClusterRuntime()
+    coord = Coordinator(cluster=cluster)
+    app = create_app(coord)
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    url = f"http://127.0.0.1:{server.server_port}"
+    yield coord, url
+    server.shutdown()
+    cluster.shutdown()
+
+
+def test_agent_end_to_end_over_http(http_coordinator):
+    coord, url = http_coordinator
+    agent = WorkerAgent(url, poll_timeout_s=0.5, register_backoff_s=0.1)
+    agent.start()
+    try:
+        assert agent.worker_id in coord.cluster.engine.worker_snapshot()
+
+        # remote client against the same REST surface
+        m = MLTaskManager(url=url)
+        status = m.train(
+            GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.1, 1.0]}, cv=3),
+            "iris",
+            show_progress=False,
+            timeout=60,
+        )
+        assert status["job_status"] == "completed"
+        assert len(status["job_result"]["results"]) == 2
+        metrics = m.check_job_status()
+        assert len(metrics) == 2
+    finally:
+        agent.stop()
+    # graceful stop unsubscribes
+    time.sleep(0.1)
+    assert agent.worker_id not in coord.cluster.engine.worker_snapshot()
+
+
+def test_agent_heartbeats_keep_it_alive(http_coordinator):
+    coord, url = http_coordinator
+    get_config().scheduler.dead_after_s = 0.5
+    agent = WorkerAgent(url, poll_timeout_s=0.2, register_backoff_s=0.1)
+    agent.start()
+    try:
+        time.sleep(1.0)  # well past dead_after without heartbeats
+        assert coord.cluster.engine.sweep() == []
+        assert agent.worker_id in coord.cluster.engine.worker_snapshot()
+    finally:
+        agent.stop()
