@@ -280,9 +280,20 @@ class Coordinator:
     def job_metrics(self, sid: str, job_id: str) -> List[Dict[str, Any]]:
         """Per-subtask results array (the reference's /metrics endpoint
         replays the Kafka metrics topic, master.py:294-340; here it's a
-        store read — same payload, no broker rewind)."""
+        store read — same payload, no broker rewind). Snapshots to
+        metrics.json like the reference (master.py:336-337)."""
         self._require_session(sid)
-        return self.store.subtask_results(sid, job_id)
+        results = self.store.subtask_results(sid, job_id)
+        try:
+            import json
+            import os
+
+            os.makedirs(self.config.storage.root, exist_ok=True)
+            with open(os.path.join(self.config.storage.root, "metrics.json"), "w") as f:
+                json.dump(json_safe(results), f, indent=2)
+        except OSError:
+            logger.exception("metrics.json snapshot failed")
+        return results
 
     def wait_for_completion(self, sid: str, job_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
         deadline = time.time() + (timeout_s or self.config.service.client_timeout_s)

@@ -41,6 +41,7 @@ class LocalExecutor:
         mesh=None,
         cache: Optional[DatasetCache] = None,
         max_trials_per_batch: Optional[int] = None,
+        fault_injector: Optional["FaultInjector"] = None,
     ):
         cfg = get_config()
         self.executor_id = executor_id
@@ -48,6 +49,9 @@ class LocalExecutor:
         self.cache = cache or DatasetCache()
         self.max_trials_per_batch = max_trials_per_batch or cfg.execution.max_trials_per_batch
         self.trial_axis = cfg.execution.trial_axis
+        self.fault_injector = fault_injector
+        self.enable_profiler = cfg.execution.enable_profiler
+        self.profiler_dir = cfg.execution.profiler_dir
 
     def run_subtasks(
         self,
@@ -66,6 +70,8 @@ class LocalExecutor:
         for (dataset_id, model_type), idxs in groups.items():
             received_at = time.time()
             try:
+                if self.fault_injector is not None:
+                    self.fault_injector.before_batch(self.executor_id, model_type)
                 kernel = get_kernel(model_type)
                 data = self.cache.get(dataset_id, kernel.task)
                 tp = subtasks[idxs[0]].get("train_params", {}) or {}
@@ -77,15 +83,17 @@ class LocalExecutor:
                     random_state=tp.get("random_state", 42),
                 )
                 started_at = time.time()
-                run = run_trials(
-                    kernel,
-                    data,
-                    plan,
-                    [subtasks[i]["parameters"] for i in idxs],
-                    mesh=self.mesh,
-                    trial_axis=self.trial_axis,
-                    max_trials_per_batch=self.max_trials_per_batch,
-                )
+                profiler_cm = self._profiler_cm(model_type)
+                with profiler_cm:
+                    run = run_trials(
+                        kernel,
+                        data,
+                        plan,
+                        [subtasks[i]["parameters"] for i in idxs],
+                        mesh=self.mesh,
+                        trial_axis=self.trial_axis,
+                        max_trials_per_batch=self.max_trials_per_batch,
+                    )
                 finished_at = time.time()
                 per_trial_time = run.run_time_s / max(len(idxs), 1)
                 for j, gi in enumerate(idxs):
@@ -168,6 +176,37 @@ class LocalExecutor:
             "mem_percent_avg": mem,
             "algo": algo,
         }
+
+
+    def _profiler_cm(self, tag: str):
+        """jax.profiler trace around a trial batch (replaces the reference's
+        psutil sampler as the deep-inspection path, SURVEY.md §5.1)."""
+        import contextlib
+
+        if not self.enable_profiler:
+            return contextlib.nullcontext()
+        import os
+
+        import jax
+
+        trace_dir = os.path.join(self.profiler_dir, f"{self.executor_id}-{tag}")
+        return jax.profiler.trace(trace_dir)
+
+
+class FaultInjector:
+    """Test/chaos hooks (SURVEY.md §5.3: 'add real fault injection hooks'):
+    delay a host's batches, fail N batches, or drop results silently."""
+
+    def __init__(self, delay_s: float = 0.0, fail_batches: int = 0):
+        self.delay_s = delay_s
+        self.fail_batches = fail_batches
+
+    def before_batch(self, executor_id: str, model_type: str) -> None:
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
+        if self.fail_batches > 0:
+            self.fail_batches -= 1
+            raise RuntimeError(f"fault injection: simulated batch failure on {executor_id}")
 
 
 def _np(y):

@@ -1,0 +1,86 @@
+"""Durability + observability: journal resume, metrics snapshot, faults."""
+
+import json
+import os
+
+from sklearn.linear_model import LogisticRegression
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.executor import (
+    FaultInjector,
+    LocalExecutor,
+)
+from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+
+def test_journal_replay_restores_job_state(tmp_path):
+    jd = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    subtasks = [{"subtask_id": f"j-subtask-{i}"} for i in range(3)]
+    store.create_job(sid, "j", {"dataset_id": "iris"}, subtasks)
+    store.update_subtask(sid, "j", "j-subtask-0", "completed", {"mean_cv_score": 0.9})
+    store.update_subtask(sid, "j", "j-subtask-1", "failed", {"error": "boom"})
+
+    resumed = JobStore(journal_dir=jd)  # fresh process, replay
+    assert resumed.has_session(sid)
+    progress = resumed.job_progress(sid, "j")
+    assert progress["tasks_completed"] == 2  # 1 completed + 1 failed
+    assert progress["tasks_pending"] == 1
+    assert resumed.subtask_results(sid, "j")[0]["mean_cv_score"] == 0.9
+
+    # finalize in the resumed store; a third replay sees completion
+    resumed.finalize_job(sid, "j", {"results": [], "best_result": None})
+    third = JobStore(journal_dir=jd)
+    assert third.job_progress(sid, "j")["job_status"] == "completed"
+
+
+def test_coordinator_journal_survives_restart():
+    coord = Coordinator(journal=True)
+    m = MLTaskManager(coordinator=coord)
+    m.train(LogisticRegression(max_iter=300), "iris", show_progress=False)
+
+    coord2 = Coordinator(journal=True)  # same storage root -> replays
+    status = coord2.check_status(m.session_id, m.job_id)
+    assert status["job_status"] == "completed"
+    assert status["job_result"]["best_result"]["accuracy"] > 0.8
+
+
+def test_metrics_json_snapshot():
+    coord = Coordinator()
+    m = MLTaskManager(coordinator=coord)
+    m.train(LogisticRegression(max_iter=300), "iris", show_progress=False)
+    m.check_job_status()
+    path = os.path.join(get_config().storage.root, "metrics.json")
+    assert os.path.exists(path)
+    snap = json.load(open(path))
+    assert snap and snap[0]["status"] == "completed"
+
+
+def test_fault_injection_fails_batch_then_recovers():
+    injector = FaultInjector(fail_batches=1)
+    coord = Coordinator(executor=LocalExecutor(fault_injector=injector))
+    coord.executor.cache = coord.cache
+    m = MLTaskManager(coordinator=coord)
+    status = m.train(LogisticRegression(max_iter=300), "iris", show_progress=False)
+    assert status["job_status"] == "completed"
+    assert len(status["job_result"]["failed"]) == 1  # injected failure surfaced
+    # next job is healthy again
+    status2 = m.train(LogisticRegression(max_iter=300), "iris", show_progress=False)
+    assert status2["job_result"]["best_result"] is not None
+
+
+def test_profiler_traces_written(tmp_path):
+    cfg = get_config()
+    cfg.execution.enable_profiler = True
+    cfg.execution.profiler_dir = str(tmp_path / "traces")
+    try:
+        coord = Coordinator()
+        m = MLTaskManager(coordinator=coord)
+        m.train(LogisticRegression(max_iter=300), "iris", show_progress=False)
+        assert os.path.isdir(cfg.execution.profiler_dir)
+        assert any(os.scandir(cfg.execution.profiler_dir))
+    finally:
+        cfg.execution.enable_profiler = False
