@@ -33,9 +33,11 @@ def main() -> None:
     from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
     from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
 
+    from scipy.stats import loguniform
+
     dataset = f"synthetic_{N_ROWS}x54x7"
     param_distributions = {
-        "C": list(np.logspace(-3, 2, 50)),
+        "C": loguniform(1e-3, 1e2),  # continuous: exactly n_iter distinct trials
         "tol": [1e-4, 1e-3],
     }
 

@@ -57,8 +57,20 @@ def collect_csv_metadata(path: str) -> Dict[str, Any]:
 
 def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
     """Load a staged CSV: features = all but last column, target = last.
-    Non-numeric feature columns are label-encoded; returns (X, y_raw, columns)."""
+    Non-numeric feature columns are label-encoded; returns (X, y_raw, columns).
+
+    A parsed-columnar sidecar (<csv>.npz) is written on first load and reused
+    while fresh — CSV stays the staging contract (reference layout), but the
+    hot path never re-parses text."""
     import pandas as pd
+
+    sidecar = path + ".npz"
+    if os.path.exists(sidecar) and os.path.getmtime(sidecar) >= os.path.getmtime(path):
+        try:
+            z = np.load(sidecar, allow_pickle=True)
+            return z["X"], z["y"], list(z["columns"])
+        except Exception:  # noqa: BLE001 — fall through to re-parse
+            pass
 
     df = pd.read_csv(path)
     X_df = df.iloc[:, :-1]
@@ -72,6 +84,10 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
         else:
             X_cols.append(series.to_numpy(dtype=np.float32))
     X = np.stack(X_cols, axis=1) if X_cols else np.zeros((len(df), 0), np.float32)
+    try:
+        np.savez(sidecar, X=X, y=y, columns=np.asarray(list(df.columns), object))
+    except OSError:
+        pass
     return X, y, list(df.columns)
 
 

@@ -91,16 +91,39 @@ class LogisticRegressionKernel(ModelKernel):
 
         W0 = jnp.zeros((dp, c), jnp.float32)
 
+        # large-n path: bf16 matmul inputs with f32 accumulation — the MXU's
+        # native mode, ~4x the f32 throughput; Newton path stays f32 (its
+        # Hessian solve is precision-sensitive and small anyway)
+        if static["_method"] == "nesterov":
+            def mm(a, b):
+                return jnp.matmul(
+                    a.astype(jnp.bfloat16),
+                    b.astype(jnp.bfloat16),
+                    preferred_element_type=jnp.float32,
+                )
+        else:
+            mm = jnp.matmul
+
         def grad_fn(W):
-            P = jax.nn.softmax(A @ W, axis=-1)
-            G = C * (A.T @ (w[:, None] * (P - Y))) + lam * pen_mask * W
+            P = jax.nn.softmax(mm(A, W), axis=-1)
+            G = C * mm(A.T, w[:, None] * (P - Y)) + lam * pen_mask * W
             return G, P
 
         if static["_method"] == "newton":
-            W = _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol)
+            steps = int(static.get("_iters", _NEWTON_STEPS))
+            W = _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol, steps)
         else:
-            W = _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol)
+            steps = int(static.get("_iters", _NESTEROV_STEPS))
+            W = _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps)
         return W
+
+    def bucket_static(self, static: Dict[str, Any], hypers) -> Dict[str, Any]:
+        """Engine hook: with the bucket's hyper values known, cap the static
+        scan length at the largest per-trial max_iter so masked-out
+        iterations aren't executed at all."""
+        cap = _NEWTON_STEPS if static["_method"] == "newton" else _NESTEROV_STEPS
+        max_iters = [int(h.get("max_iter", 100)) for h in hypers] or [cap]
+        return {**static, "_iters": max(1, min(cap, max(max_iters)))}
 
     def predict(self, params, X, static: Dict[str, Any]):
         fit_intercept = bool(static.get("fit_intercept", True))
@@ -112,7 +135,7 @@ class LogisticRegressionKernel(ModelKernel):
         return max(1.0, 4.0 * n * (d + 1 + c) * 2 / 1e6)
 
 
-def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol):
+def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol, steps=_NEWTON_STEPS):
     n, dp = A.shape
     c = Y.shape[1]
     dim = dp * c
@@ -160,12 +183,12 @@ def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol):
         return (W, done), None
 
     (W, _), _ = jax.lax.scan(
-        step, (W0, jnp.asarray(False)), jnp.arange(_NEWTON_STEPS, dtype=jnp.float32)
+        step, (W0, jnp.asarray(False)), jnp.arange(steps, dtype=jnp.float32)
     )
     return W
 
 
-def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol):
+def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps=_NESTEROV_STEPS):
     # Lipschitz bound: L <= 0.5 * C * lambda_max(A' diag(w) A) + lam
     v = jnp.ones((A.shape[1],), jnp.float32)
 
@@ -193,6 +216,6 @@ def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol):
     (W, _, _), _ = jax.lax.scan(
         body,
         (W0, W0, jnp.asarray(False)),
-        jnp.arange(_NESTEROV_STEPS, dtype=jnp.float32),
+        jnp.arange(steps, dtype=jnp.float32),
     )
     return W

@@ -87,6 +87,9 @@ def run_trials(
         else:
             X = jnp.asarray(data.X, jnp.float32)
 
+        if hasattr(kernel, "bucket_static"):
+            static = kernel.bucket_static(static, [hypers[i] for i in idxs])
+
         hyper_names = sorted(hypers[idxs[0]].keys())
         chunk = min(max_trials_per_batch, pad_to_multiple(len(idxs), n_dev))
         chunk = pad_to_multiple(chunk, n_dev)
@@ -156,14 +159,25 @@ def fit_single(
     y = jnp.asarray(data.y)
     w = jnp.asarray(plan.train_w[0])
     hyper_arg = {k: jnp.asarray(v, jnp.float32) for k, v in hyper.items()}
-    fitted = jax.jit(lambda X, y, w, h: kernel.fit(X, y, w, h, static))(X, y, w, hyper_arg)
+    fit_key = (
+        "fit_single",
+        kernel.name,
+        tuple(sorted((k, str(v)) for k, v in static.items())),
+        data.X.shape,
+        data.n_classes,
+    )
+    if fit_key not in _compiled_cache:
+        _compiled_cache[fit_key] = jax.jit(
+            lambda X, y, w, h: kernel.fit(X, y, w, h, static)
+        )
+    fitted = _compiled_cache[fit_key](X, y, w, hyper_arg)
     return jax.tree_util.tree_map(np.asarray, fitted), static
 
 
 def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk, has_hyper):
     cache_key = (
         kernel.name,
-        static_key,
+        tuple(sorted((k, str(v)) for k, v in static.items())),
         data.X.shape,
         data.n_classes,
         plan.n_splits,

@@ -43,6 +43,9 @@ class LocalExecutor:
         max_trials_per_batch: Optional[int] = None,
         fault_injector: Optional["FaultInjector"] = None,
     ):
+        from ..utils.jax_setup import setup_jax
+
+        setup_jax()
         cfg = get_config()
         self.executor_id = executor_id
         self.mesh = mesh
