@@ -131,8 +131,10 @@ class LogisticRegressionKernel(ModelKernel):
         return jnp.argmax(A @ params, axis=-1).astype(jnp.int32)
 
     def memory_estimate_mb(self, n, d, static):
+        # marginal per-(trial,split) working set: a few [n, c] activation/
+        # gradient buffers (the [n, d] design matrix is shared, not vmapped)
         c = max(int(static.get("_n_classes", 2)), 2)
-        return max(1.0, 4.0 * n * (d + 1 + c) * 2 / 1e6)
+        return max(1.0, 3.0 * 4.0 * n * c / 1e6)
 
 
 def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol, steps=_NEWTON_STEPS):

@@ -91,8 +91,9 @@ def run_trials(
             static = kernel.bucket_static(static, [hypers[i] for i in idxs])
 
         hyper_names = sorted(hypers[idxs[0]].keys())
-        chunk = min(max_trials_per_batch, pad_to_multiple(len(idxs), n_dev))
-        chunk = pad_to_multiple(chunk, n_dev)
+        mem_cap = _memory_chunk_cap(kernel, n, d, static, plan.n_splits, n_dev)
+        chunk = min(max_trials_per_batch, mem_cap, pad_to_multiple(len(idxs), n_dev))
+        chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
         fn, fresh_compile = _get_compiled(
             kernel, static_key, static, mesh, trial_axis, data, plan, chunk, bool(hyper_names)
@@ -172,6 +173,24 @@ def fit_single(
         )
     fitted = _compiled_cache[fit_key](X, y, w, hyper_arg)
     return jax.tree_util.tree_map(np.asarray, fitted), static
+
+
+def _device_memory_mb() -> float:
+    try:
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return stats["bytes_limit"] / 1e6
+    except Exception:  # noqa: BLE001
+        pass
+    return 8_000.0
+
+
+def _memory_chunk_cap(kernel, n, d, static, n_splits, n_dev) -> int:
+    """Trials per dispatch bounded by per-device HBM: each in-flight trial
+    holds ~memory_estimate_mb per split concurrently under the split vmap."""
+    per_trial_mb = max(kernel.memory_estimate_mb(n, d, static), 0.5) * max(n_splits, 1)
+    budget_mb = 0.5 * _device_memory_mb() * max(n_dev, 1)
+    return max(n_dev, int(budget_mb / per_trial_mb))
 
 
 def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk, has_hyper):
