@@ -109,6 +109,16 @@ def materialize_builtin(name: str, root: Optional[str] = None) -> Optional[str]:
         df = bunch.frame  # target already last column
     elif name_l in ("covertype", "covtype"):
         df = _synthetic_covertype()
+    elif name_l == "titanic":
+        df = _synthetic_titanic()
+        # titanic ships raw (nulls, categoricals): the preprocess pipeline is
+        # part of the demo flow, so only the raw CSV is staged
+        base = dataset_dir(name, root)
+        os.makedirs(base, exist_ok=True)
+        raw_path = os.path.join(base, f"{name}.csv")
+        if not os.path.exists(raw_path):
+            df.to_csv(raw_path, index=False)
+        return raw_path
     elif name_l.startswith("synthetic"):
         df = _synthetic_classification(name_l)
     else:
@@ -146,6 +156,43 @@ def _synthetic_covertype(n: int = 116_202) -> "Any":
     df = pd.DataFrame(X.astype(np.float32), columns=[f"f{i}" for i in range(54)])
     df["Cover_Type"] = y + 1
     return df
+
+
+def _synthetic_titanic(n: int = 891) -> "Any":
+    """Titanic-shaped synthetic table (same columns, nulls, and categorical
+    mix as the Kaggle dataset the reference demos use) so the full
+    download->preprocess(yaml)->train demo runs with zero egress."""
+    import pandas as pd
+
+    rng = np.random.RandomState(7)
+    pclass = rng.choice([1, 2, 3], n, p=[0.24, 0.21, 0.55])
+    sex = rng.choice(["male", "female"], n, p=[0.65, 0.35])
+    age = np.round(rng.normal(29.7, 14.5, n).clip(0.4, 80), 1)
+    age[rng.rand(n) < 0.2] = np.nan
+    sibsp = rng.choice([0, 1, 2, 3, 4], n, p=[0.68, 0.23, 0.05, 0.03, 0.01])
+    parch = rng.choice([0, 1, 2], n, p=[0.76, 0.13, 0.11])
+    fare = np.round(np.exp(rng.normal(2.9, 1.0, n)).clip(0, 512), 4)
+    embarked = rng.choice(["S", "C", "Q"], n, p=[0.72, 0.19, 0.09]).astype(object)
+    embarked[rng.rand(n) < 0.002] = None
+    # survival correlated with sex/class/age like the real data
+    logit = 1.2 - 0.9 * (pclass - 1) + 2.4 * (sex == "female") - 0.015 * np.nan_to_num(age, nan=29.7)
+    survived = (rng.rand(n) < 1 / (1 + np.exp(-logit))).astype(int)
+    return pd.DataFrame(
+        {
+            "PassengerId": np.arange(1, n + 1),
+            "Survived": survived,
+            "Pclass": pclass,
+            "Name": [f"Passenger {i}" for i in range(n)],
+            "Sex": sex,
+            "Age": age,
+            "SibSp": sibsp,
+            "Parch": parch,
+            "Ticket": [f"T{100000+i}" for i in range(n)],
+            "Fare": fare,
+            "Cabin": [None] * n,
+            "Embarked": embarked,
+        }
+    )
 
 
 def _synthetic_classification(spec: str) -> "Any":

@@ -1,0 +1,130 @@
+"""GaussianNB kernel + single decision trees (beyond-whitelist estimators).
+
+Not in the reference's 15-name whitelist but standard sklearn surface its
+users expect; both are nearly free here: GaussianNB is three weighted
+moment reductions, and DecisionTree* reuse the histogram tree core with
+n_estimators=1 and no bootstrap/feature subsetting.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.trees import build_tree, predict_tree
+from .base import ModelKernel
+from .trees import _TreeBase
+
+_EPS = 1e-9
+
+
+class GaussianNBKernel(ModelKernel):
+    name = "GaussianNB"
+    task = "classification"
+    hyper_defaults = {"var_smoothing": 1e-9}
+    static_defaults: Dict[str, Any] = {}
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        c = max(int(static["_n_classes"]), 2)
+        X = X.astype(jnp.float32)
+        w = w.astype(jnp.float32)
+        Y = jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None]  # [n, c]
+        counts = jnp.maximum(jnp.sum(Y, axis=0), _EPS)  # [c]
+        mean = (Y.T @ X) / counts[:, None]  # [c, d]
+        sq = (Y.T @ (X * X)) / counts[:, None]
+        var = jnp.maximum(sq - mean**2, 0.0)
+        # sklearn: var += var_smoothing * max feature variance
+        wsum = jnp.maximum(jnp.sum(w), _EPS)
+        gmean = jnp.sum(X * w[:, None], 0) / wsum
+        gvar = jnp.sum(w[:, None] * (X - gmean) ** 2, 0) / wsum
+        var = var + jnp.asarray(hyper["var_smoothing"], jnp.float32) * jnp.max(gvar)
+        prior = counts / jnp.sum(counts)
+        return {"mean": mean, "var": var, "log_prior": jnp.log(prior)}
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        X = X.astype(jnp.float32)
+        mean, var = params["mean"], params["var"]  # [c, d]
+        ll = -0.5 * jnp.sum(
+            jnp.log(2 * jnp.pi * var)[None, :, :]
+            + (X[:, None, :] - mean[None, :, :]) ** 2 / var[None, :, :],
+            axis=-1,
+        )
+        return jnp.argmax(ll + params["log_prior"][None, :], axis=-1).astype(jnp.int32)
+
+
+class _DecisionTreeBase(_TreeBase):
+    static_defaults = {
+        "max_depth": None,
+        "min_samples_leaf": 1,
+        "min_samples_split": 2,
+        "max_features": None,
+        "random_state": 0,
+        "n_bins": 128,
+        "criterion": "default",
+        "splitter": "best",
+        "min_weight_fraction_leaf": 0.0,
+        "max_leaf_nodes": None,
+        "min_impurity_decrease": 0.0,
+        "ccp_alpha": 0.0,
+        "monotonic_cst": None,
+    }
+    _mf_default = 1.0
+
+    def _fit_tree(self, xb, S, C, static):
+        return build_tree(
+            xb,
+            S,
+            C,
+            depth=static["_depth"],
+            n_bins=static["_n_bins"],
+            min_samples_leaf=static["_msl"],
+            max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
+            key=jax.random.PRNGKey(static["_seed"]),
+        )
+
+
+class DecisionTreeClassifierKernel(_DecisionTreeBase):
+    name = "DecisionTreeClassifier"
+    task = "classification"
+
+    def fit(self, X, y, w, hyper, static):
+        xb = X["xb"] if isinstance(X, dict) else X
+        c = max(int(static["_n_classes"]), 2)
+        w = w.astype(jnp.float32)
+        S = jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None]
+        params = {"tree": self._fit_tree(xb, S, w, static)}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
+    def predict(self, params, X, static):
+        xq = self._query_bins(params, X, static)
+        proba = predict_tree(xq, params["tree"], static["_depth"])
+        return jnp.argmax(proba, axis=-1).astype(jnp.int32)
+
+
+class DecisionTreeRegressorKernel(_DecisionTreeBase):
+    name = "DecisionTreeRegressor"
+    task = "regression"
+
+    def fit(self, X, y, w, hyper, static):
+        xb = X["xb"] if isinstance(X, dict) else X
+        w = w.astype(jnp.float32)
+        S = (y.astype(jnp.float32) * w)[:, None]
+        params = {"tree": self._fit_tree(xb, S, w, static)}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
+    def predict(self, params, X, static):
+        xq = self._query_bins(params, X, static)
+        return predict_tree(xq, params["tree"], static["_depth"])[:, 0]
+
+
+from .registry import register_kernel  # noqa: E402  (self-registration on import)
+
+register_kernel(GaussianNBKernel())
+register_kernel(DecisionTreeClassifierKernel())
+register_kernel(DecisionTreeRegressorKernel())

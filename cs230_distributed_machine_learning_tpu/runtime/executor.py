@@ -106,6 +106,7 @@ class LocalExecutor:
                         "job_id": st.get("job_id"),
                         "model_type": model_type,
                         "parameters": st["parameters"],
+                        "search_params": st.get("search_params"),
                         "training_time": per_trial_time,
                         "status": "completed",
                         **run.trial_metrics[j],
