@@ -29,6 +29,18 @@ import jax.numpy as jnp
 from .base import ModelKernel
 
 _QUERY_BLOCK = 1024
+# above this many training rows on TPU, use the fused Pallas top-k kernel
+# (streams train tiles through VMEM; the XLA path would materialize a
+# [block, n] distance matrix per query block)
+_PALLAS_MIN_N = 150_000
+
+
+def _use_pallas(n: int) -> bool:
+    if n < _PALLAS_MIN_N:
+        return False
+    import jax
+
+    return jax.default_backend() not in ("cpu",)
 
 
 class _KNNBase(ModelKernel):
@@ -55,6 +67,10 @@ class _KNNBase(ModelKernel):
         k = int(static["n_neighbors"])
         Xt = params["X"]
         w = params["w"]
+        if _use_pallas(Xt.shape[0]):
+            from ..ops.pallas_knn import knn_topk
+
+            return knn_topk(Q, Xt, w, k)
         sq_t = jnp.sum(Xt * Xt, axis=1)  # [n]
         big = jnp.float32(3.4e38)
 
