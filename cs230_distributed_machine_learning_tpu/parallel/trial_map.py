@@ -73,7 +73,7 @@ def run_trials(
     TW = jnp.asarray(plan.train_w)
     EW = jnp.asarray(plan.eval_w)
 
-    n_dev = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    n_dev = int(mesh.shape[trial_axis]) if mesh is not None else 1
     for static_key, idxs in buckets.items():
         static = kernel.static_from_key(static_key)
         if hasattr(kernel, "resolve_static"):
@@ -96,7 +96,7 @@ def run_trials(
         chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
         fn, fresh_compile = _get_compiled(
-            kernel, static_key, static, mesh, trial_axis, data, plan, chunk, bool(hyper_names)
+            kernel, static_key, static, mesh, trial_axis, data, plan, chunk, bool(hyper_names), X
         )
 
         for start in range(0, len(idxs), chunk):
@@ -193,7 +193,7 @@ def _memory_chunk_cap(kernel, n, d, static, n_splits, n_dev) -> int:
     return max(n_dev, int(budget_mb / per_trial_mb))
 
 
-def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk, has_hyper):
+def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk, has_hyper, X_proto=None):
     cache_key = (
         kernel.name,
         tuple(sorted((k, str(v)) for k, v in static.items())),
@@ -219,11 +219,39 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
     if mesh is not None:
         replicated = NamedSharding(mesh, P())
         trial_sharded = NamedSharding(mesh, P(trial_axis))
-        fn = jax.jit(
-            batched,
-            in_shardings=(replicated, replicated, replicated, replicated, trial_sharded),
-            out_shardings=trial_sharded,
-        )
+        # 2-D mesh (trials, data): additionally shard the sample dimension of
+        # the dataset arrays across the data axis — XLA inserts the psum/
+        # all-gather collectives inside each trial's fit (batch parallelism
+        # within a trial, trial parallelism across the other axis)
+        data_axis = next((a for a in mesh.shape if a != trial_axis), None)
+        n = data.X.shape[0] if not isinstance(data.X, dict) else None
+        if n is None:
+            n = data.n_samples
+        if data_axis is not None and X_proto is not None:
+            def shard_rows(leaf_dims_first_is_n, row_axis_pos=0):
+                spec = [None] * leaf_dims_first_is_n
+                spec[row_axis_pos] = data_axis
+                return NamedSharding(mesh, P(*spec))
+
+            def leaf_sharding(leaf):
+                if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] == n:
+                    return shard_rows(leaf.ndim, 0)
+                return replicated
+
+            X_shardings = jax.tree_util.tree_map(leaf_sharding, X_proto)
+            y_sh = NamedSharding(mesh, P(data_axis))
+            w_sh = NamedSharding(mesh, P(None, data_axis))
+            fn = jax.jit(
+                batched,
+                in_shardings=(X_shardings, y_sh, w_sh, w_sh, trial_sharded),
+                out_shardings=trial_sharded,
+            )
+        else:
+            fn = jax.jit(
+                batched,
+                in_shardings=(replicated, replicated, replicated, replicated, trial_sharded),
+                out_shardings=trial_sharded,
+            )
     else:
         fn = jax.jit(batched)
     _compiled_cache[cache_key] = fn

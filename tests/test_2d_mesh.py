@@ -1,0 +1,31 @@
+"""2-D (trials, data) mesh: batch-dimension sharding inside trials."""
+
+import numpy as np
+from sklearn.datasets import load_iris
+
+from cs230_distributed_machine_learning_tpu.models.base import TrialData
+from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
+from cs230_distributed_machine_learning_tpu.parallel.trial_map import run_trials
+
+
+def test_2d_mesh_matches_1d_results():
+    X, y = load_iris(return_X_y=True)
+    data = TrialData(X=X[:144].astype(np.float32), y=y[:144].astype(np.int32), n_classes=3)
+    plan = build_split_plan(np.asarray(data.y), task="classification", n_folds=3)
+    kernel = get_kernel("LogisticRegression")
+    params = [{"C": c} for c in [0.1, 1.0, 10.0, 100.0]]
+
+    out_1d = run_trials(kernel, data, plan, params, mesh=trial_mesh())
+    out_2d = run_trials(kernel, data, plan, params, mesh=trial_mesh(data_parallel=2))
+    s1 = [m["mean_cv_score"] for m in out_1d.trial_metrics]
+    s2 = [m["mean_cv_score"] for m in out_2d.trial_metrics]
+    np.testing.assert_allclose(s1, s2, atol=2e-3)
+
+
+def test_2d_mesh_shape_validation():
+    import pytest
+
+    with pytest.raises(ValueError):
+        trial_mesh(data_parallel=3)  # 8 devices not divisible by 3
