@@ -1,0 +1,177 @@
+"""Measure the 5 BASELINE.json reference configs: sklearn-reference-style vs
+this framework.
+
+BASELINE.md: the reference never published numbers, so the denominator must
+be measured "with the reference's own harness pattern (results1.py)" — i.e.
+per-trial sklearn fit + scoring + 5-fold cross_val_score on CPU
+(worker.py:289-349 semantics). Large sklearn sweeps are measured on a
+trial subsample and extrapolated linearly (marked `extrapolated`).
+
+Writes benchmarks/BASELINE_MEASURED.json and prints a summary table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager  # noqa: E402
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator  # noqa: E402
+
+
+def _sk_trial(model, X, y, cv=5):
+    """One reference-style trial: holdout fit + eval + full-data k-fold CV."""
+    from sklearn.model_selection import cross_val_score, train_test_split
+
+    Xt, Xe, yt, ye = train_test_split(X, y, test_size=0.2, random_state=42)
+    model.fit(Xt, yt)
+    model.score(Xe, ye)
+    cross_val_score(model, X, y, cv=cv)
+
+
+def _ours(manager, estimator, dataset, n_expected=None):
+    t0 = time.time()
+    status = manager.train(estimator, dataset, {"random_state": 42},
+                           show_progress=False, timeout=3600)
+    wall = time.time() - t0
+    assert status["job_status"] == "completed", status
+    results = status["job_result"]["results"]
+    if n_expected:
+        assert len(results) == n_expected, (len(results), n_expected)
+    best = status["job_result"]["best_result"]
+    return wall, len(results), best
+
+
+def main() -> None:
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    from scipy.stats import loguniform
+    from sklearn.ensemble import GradientBoostingRegressor, RandomForestClassifier
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import (
+        GridSearchCV,
+        ParameterGrid,
+        ParameterSampler,
+        RandomizedSearchCV,
+    )
+    from sklearn.neural_network import MLPClassifier
+
+    manager = MLTaskManager(coordinator=Coordinator())
+    cache = manager._coordinator.cache
+    report = []
+
+    def record(name, sk_time, sk_extrapolated, our_time, n_trials, note=""):
+        report.append(
+            {
+                "config": name,
+                "sklearn_reference_s": round(sk_time, 3),
+                "sklearn_extrapolated": sk_extrapolated,
+                "framework_s": round(our_time, 3),
+                "speedup": round(sk_time / our_time, 2) if our_time else None,
+                "n_trials": n_trials,
+                "note": note,
+            }
+        )
+        print(f"{name}: sklearn {sk_time:.1f}s  ours {our_time:.1f}s  "
+              f"({sk_time / our_time:.1f}x)  [{n_trials} trials]")
+
+    # ---- 1. RandomForestClassifier on iris (plain fit) ----
+    data = cache.get("iris", "classification")
+    X, y = np.asarray(data.X), np.asarray(data.y)
+    t0 = time.time()
+    _sk_trial(RandomForestClassifier(random_state=42), X, y)
+    sk = time.time() - t0
+    ours, n, _ = _ours(manager, RandomForestClassifier(n_estimators=100, random_state=42), "iris", 1)
+    record("1. RandomForestClassifier iris (plain)", sk, False, ours, n)
+
+    # ---- 2. LogisticRegression GridSearchCV on iris (8-cell, cv=5) ----
+    grid = {"C": [0.01, 0.1, 1.0, 10.0], "fit_intercept": [True, False]}
+    t0 = time.time()
+    for combo in ParameterGrid(grid):
+        _sk_trial(LogisticRegression(max_iter=1000, **combo), X, y)
+    sk = time.time() - t0
+    ours, n, best = _ours(
+        manager, GridSearchCV(LogisticRegression(max_iter=1000), grid, cv=5), "iris", 8
+    )
+    sk_search = GridSearchCV(LogisticRegression(max_iter=1000), grid, cv=5).fit(X, y)
+    parity = best["search_params"]["C"] == sk_search.best_params_["C"]
+    record("2. LogReg GridSearchCV iris 8-cell", sk, False, ours, n,
+           note=f"best_params match sklearn: {parity}")
+
+    # ---- 3. RandomizedSearchCV LogReg on Covertype (1000 trials) ----
+    data = cache.get("covertype", "classification")
+    Xc, yc = np.asarray(data.X), np.asarray(data.y)
+    dists = {"C": loguniform(1e-3, 1e2)}
+    sample = list(ParameterSampler(dists, n_iter=2, random_state=0))
+    t0 = time.time()
+    for combo in sample:
+        _sk_trial(LogisticRegression(max_iter=200, **combo), Xc, yc)
+    sk = (time.time() - t0) / len(sample) * 1000
+    ours, n, _ = _ours(
+        manager,
+        RandomizedSearchCV(LogisticRegression(max_iter=200), dists, n_iter=1000,
+                           cv=5, random_state=0),
+        "covertype",
+        1000,
+    )
+    record("3. RandomizedSearch LogReg covertype 1000", sk, True, ours, n,
+           note="sklearn extrapolated from 2 trials")
+
+    # ---- 4. GradientBoostingRegressor GridSearchCV on titanic ----
+    manager.download_data("titanic", "titanic", "builtin")
+    import yaml
+
+    cfg = yaml.safe_load(open(os.path.join(os.path.dirname(__file__), "..",
+                                           "examples", "titanic_preprocess.yaml")))
+    manager.preprocess("titanic", cfg)
+    data = cache.get("titanic", "regression")
+    Xt, yt = np.asarray(data.X), np.asarray(data.y)
+    ggrid = {"n_estimators": [50, 100], "learning_rate": [0.05, 0.1]}
+    t0 = time.time()
+    for combo in ParameterGrid(ggrid):
+        _sk_trial(GradientBoostingRegressor(random_state=0, **combo), Xt, yt)
+    sk = time.time() - t0
+    ours, n, _ = _ours(
+        manager, GridSearchCV(GradientBoostingRegressor(random_state=0), ggrid, cv=5),
+        "titanic", 4,
+    )
+    record("4. GBRegressor GridSearchCV titanic (yaml)", sk, False, ours, n)
+
+    # ---- 5. MLPClassifier RandomizedSearchCV on MNIST-shaped data ----
+    mnist = "synthetic_10000x784x10"
+    data = cache.get(mnist, "classification")
+    Xm, ym = np.asarray(data.X), np.asarray(data.y)
+    mdists = {"learning_rate_init": [1e-4, 1e-3, 1e-2], "alpha": [1e-5, 1e-4, 1e-3]}
+    msample = list(ParameterSampler(mdists, n_iter=2, random_state=0))
+    t0 = time.time()
+    for combo in msample:
+        _sk_trial(MLPClassifier(hidden_layer_sizes=(128,), max_iter=30,
+                                random_state=0, **combo), Xm, ym)
+    sk = (time.time() - t0) / len(msample) * 8
+    ours, n, _ = _ours(
+        manager,
+        RandomizedSearchCV(
+            MLPClassifier(hidden_layer_sizes=(128,), max_iter=30, random_state=0),
+            mdists, n_iter=8, cv=5, random_state=0,
+        ),
+        mnist,
+        8,
+    )
+    record("5. MLP RandomizedSearch MNIST-shaped 8", sk, True, ours, n,
+           note="sklearn extrapolated from 2 trials")
+
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json")
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"\nwrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

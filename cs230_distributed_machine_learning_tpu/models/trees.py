@@ -61,8 +61,15 @@ class _TreeBase(ModelKernel):
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         depth = static.get("max_depth")
-        depth = _DEPTH_CAP if depth is None else min(int(depth), _DEPTH_CAP)
+        if depth is None:
+            # sklearn grows to purity; a tree on n samples can't use more than
+            # ~log2(n) useful levels, so cap there — deeper levels would be
+            # all pass-through nodes, paid for in compile time
+            depth = min(_DEPTH_CAP, max(3, int(np.ceil(np.log2(max(n, 8)))) - 2))
+        else:
+            depth = min(int(depth), _DEPTH_CAP)
         n_bins = int(static.get("n_bins", 128))
+        n_bins = min(n_bins, max(8, n))
         mf = _resolve_max_features(static.get("max_features"), d, self._mf_default)
         msl = static.get("min_samples_leaf", 1)
         if isinstance(msl, float) and msl < 1:

@@ -65,6 +65,9 @@ class Coordinator:
         # retained in cluster mode too: artifact refits run coordinator-side
         self.executor = executor or LocalExecutor(mesh=mesh, cache=self.cache)
         self._job_threads: Dict[str, threading.Thread] = {}
+        self._artifact_lock = threading.Lock()
+        self._artifact_specs: Dict[Any, Dict[str, Any]] = {}
+        self._artifact_paths: Dict[Any, str] = {}
 
     # ------------- session / data management (master.py:56-112 parity) -------------
 
@@ -218,7 +221,7 @@ class Coordinator:
             completed, key=lambda r: r.get("mean_cv_score", float("-inf")), reverse=True
         )
         best = dict(ranked[0]) if ranked else None
-        if best is not None and len(completed) > 1:
+        if best is not None and len(completed) > 1:  # noqa: SIM102
             # winner selection on-device over the mesh trial axis (ICI
             # collective argmax; replaces the master-side Redis sort)
             from ..parallel.collectives import best_trial
@@ -232,14 +235,12 @@ class Coordinator:
             )
             best = dict(completed[idx])
         if best is not None:
+            # artifact refit is lazy: materialized on the first
+            # download_best_model call (the reference eagerly pickled every
+            # trial's model, worker.py:352-356 — pure overhead for searches)
             st = next(s for s in subtasks if s["subtask_id"] == best["subtask_id"])
-            try:
-                artifact = self.executor.fit_artifact(st)
-                best["model_path"] = save_artifact(
-                    best["subtask_id"], artifact, self.config.storage.models_dir
-                )
-            except Exception:  # noqa: BLE001
-                logger.exception("Best-model artifact fit failed for %s", job_id)
+            with self._artifact_lock:
+                self._artifact_specs[(sid, job_id)] = st
         self.store.finalize_job(
             sid,
             job_id,
@@ -309,7 +310,20 @@ class Coordinator:
         job = self.store.get_job(sid, job_id)
         result = job.get("result") or {}
         best = result.get("best_result") or {}
-        return best.get("model_path")
+        if best.get("model_path"):
+            return best["model_path"]
+        with self._artifact_lock:
+            path = self._artifact_paths.get((sid, job_id))
+            if path is not None:
+                return path
+            st = self._artifact_specs.get((sid, job_id))
+        if st is None:
+            return None
+        artifact = self.executor.fit_artifact(st)
+        path = save_artifact(st["subtask_id"], artifact, self.config.storage.models_dir)
+        with self._artifact_lock:
+            self._artifact_paths[(sid, job_id)] = path
+        return path
 
     def _require_session(self, sid: str) -> None:
         if not self.store.has_session(sid):
