@@ -277,4 +277,9 @@ def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str)
         metrics["mean_cv_score"] = float(np.mean(cv))
     else:
         metrics["mean_cv_score"] = score
+    # a diverged trial (NaN/inf score from a pathological hyper combo) must
+    # rank last, not poison the sort — Python sorted() with NaN is undefined
+    if not np.isfinite(metrics["mean_cv_score"]):
+        metrics["mean_cv_score"] = float("-inf")
+        metrics["diverged"] = True
     return metrics

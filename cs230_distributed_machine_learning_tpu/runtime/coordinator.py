@@ -217,9 +217,14 @@ class Coordinator:
         downloadable artifact."""
         completed = [r for r in results if r and r.get("status") == "completed"]
         failed = [r for r in results if r and r.get("status") == "failed"]
-        ranked = sorted(
-            completed, key=lambda r: r.get("mean_cv_score", float("-inf")), reverse=True
-        )
+
+        def score_key(r):
+            # None survives JSON round-trips from remote agents (inf/NaN are
+            # nulled by json_safe); rank those trials last
+            v = r.get("mean_cv_score")
+            return v if isinstance(v, (int, float)) else float("-inf")
+
+        ranked = sorted(completed, key=score_key, reverse=True)
         best = dict(ranked[0]) if ranked else None
         if best is not None and len(completed) > 1:  # noqa: SIM102
             # winner selection on-device over the mesh trial axis (ICI
@@ -227,7 +232,7 @@ class Coordinator:
             from ..parallel.collectives import best_trial
 
             idx, _ = best_trial(
-                [r.get("mean_cv_score", float("-inf")) for r in completed],
+                [score_key(r) for r in completed],
                 mesh=getattr(self.executor, "mesh", None),
             )
             assert completed[idx]["subtask_id"] == best["subtask_id"] or (
