@@ -129,7 +129,7 @@ class MLTaskManager:
     ) -> Dict[str, Any]:
         cfg = get_config().service
         timeout = timeout or cfg.client_timeout_s
-        poll = cfg.client_poll_s if self._coordinator is None else 0.05
+        poll = cfg.client_poll_s if self._coordinator is None else 0.1
         bar = None
         if show_progress:
             try:
@@ -141,18 +141,25 @@ class MLTaskManager:
         deadline = time.time() + timeout
         try:
             while time.time() < deadline:
+                if self._coordinator is not None:
+                    # event-driven: wake on finalize (or after `poll` to
+                    # refresh the progress bar), never a blind sleep
+                    self._coordinator.store.wait_job(
+                        self.session_id,
+                        self.job_id,
+                        timeout=(min(poll, deadline - time.time()) if bar is not None
+                                 else deadline - time.time()),
+                    )
                 status = self.check_status()
                 job_status = status.get("job_status")
                 if bar is not None:
                     bar.n = int(_pct(job_status))
                     bar.refresh()
-                if job_status == "completed":
+                if job_status in ("completed", "failed"):
                     self.result = status.get("job_result")
                     return status
-                if job_status == "failed":
-                    self.result = status.get("job_result")
-                    return status
-                time.sleep(poll)
+                if self._coordinator is None:
+                    time.sleep(poll)
         finally:
             if bar is not None:
                 bar.close()

@@ -302,13 +302,10 @@ class Coordinator:
         return results
 
     def wait_for_completion(self, sid: str, job_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
-        deadline = time.time() + (timeout_s or self.config.service.client_timeout_s)
-        while time.time() < deadline:
-            progress = self.store.job_progress(sid, job_id)
-            if progress["job_status"] in ("completed", "failed"):
-                return progress
-            time.sleep(0.05)
-        raise TimeoutError(f"Job {job_id} did not complete in time")
+        timeout = timeout_s or self.config.service.client_timeout_s
+        if not self.store.wait_job(sid, job_id, timeout):
+            raise TimeoutError(f"Job {job_id} did not complete in time")
+        return self.store.job_progress(sid, job_id)
 
     def best_model_path(self, sid: str, job_id: str) -> Optional[str]:
         self._require_session(sid)

@@ -30,6 +30,7 @@ class JobStore:
     def __init__(self, journal_dir: Optional[str] = None):
         self._lock = threading.RLock()
         self._sessions: Dict[str, Dict[str, Any]] = {}
+        self._done_events: Dict[tuple, threading.Event] = {}
         self._journal_path = None
         if journal_dir:
             os.makedirs(journal_dir, exist_ok=True)
@@ -82,6 +83,12 @@ class JobStore:
         }
         with self._lock:
             self._require_session(sid)["jobs"][job_id] = record
+            # a client-supplied job_id may reuse a finalized one; drop a
+            # stale already-set event so wait_job blocks on the new run, but
+            # keep an unset one — live waiters must wake on this run's finalize
+            stale = self._done_events.get((sid, job_id))
+            if stale is not None and stale.is_set():
+                del self._done_events[(sid, job_id)]
         self._journal({"op": "create_job", "sid": sid, "record": record})
 
     def update_subtask(
@@ -126,9 +133,27 @@ class JobStore:
             job["result"] = json_safe(result)
             job["status"] = status
             job["completion_time"] = time.time()
-        self._journal(
-            {"op": "finalize_job", "sid": sid, "jid": job_id, "result": json_safe(result)}
-        )
+            # pop, don't keep: late waiters short-circuit on the status check
+            # in wait_job, and pruning here bounds the dict's size
+            event = self._done_events.pop((sid, job_id), None)
+        try:
+            self._journal(
+                {"op": "finalize_job", "sid": sid, "jid": job_id, "result": json_safe(result)}
+            )
+        finally:
+            if event is not None:
+                event.set()
+
+    def wait_job(self, sid: str, job_id: str, timeout: Optional[float] = None) -> bool:
+        """Block until the job is finalized (completed or failed). Event-driven
+        — the in-process replacement for the reference client's 1 s Redis
+        poll loop (core.py:180-199); returns False on timeout."""
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            if job["status"] in ("completed", "failed"):
+                return True
+            event = self._done_events.setdefault((sid, job_id), threading.Event())
+        return event.wait(timeout)
 
     def get_job(self, sid: str, job_id: str) -> Dict[str, Any]:
         with self._lock:

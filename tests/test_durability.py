@@ -84,3 +84,28 @@ def test_profiler_traces_written(tmp_path):
         assert any(os.scandir(cfg.execution.profiler_dir))
     finally:
         cfg.execution.enable_profiler = False
+
+
+def test_wait_job_is_event_driven():
+    """wait_job blocks until finalize_job fires the event, with no polling,
+    and returns immediately for already-finalized jobs."""
+    import threading
+    import time
+
+    store = JobStore()
+    sid = store.create_session()
+    store.create_job(sid, "j", {}, [{"subtask_id": "j-subtask-0"}])
+
+    assert store.wait_job(sid, "j", timeout=0.05) is False  # not done yet
+
+    t = threading.Timer(
+        0.1, store.finalize_job, args=(sid, "j", {"results": [], "best_result": None})
+    )
+    t0 = time.time()
+    t.start()
+    try:
+        assert store.wait_job(sid, "j", timeout=5.0) is True
+        assert time.time() - t0 < 2.0  # woke on the event, not the timeout
+        assert store.wait_job(sid, "j", timeout=0.0) is True  # already done
+    finally:
+        t.cancel()
