@@ -45,9 +45,16 @@ def find_csv(dataset_id: str, *, preprocessed: bool = False, root: Optional[str]
 def collect_csv_metadata(path: str) -> Dict[str, Any]:
     """n_rows / n_cols / size_mb, the features the runtime predictor learns
     from (reference ``dataset_util.py:119-136``)."""
+    size_mb = round(os.path.getsize(path) / (1024 * 1024), 2)
+
+    from ..native import csv_dims
+
+    dims = csv_dims(path)  # native mmap scan; None without a toolchain
+    if dims is not None:
+        return {"n_rows": dims[0], "n_cols": dims[1], "size_mb": size_mb}
+
     import pandas as pd
 
-    size_mb = round(os.path.getsize(path) / (1024 * 1024), 2)
     df = pd.read_csv(path, nrows=1)
     n_cols = df.shape[1]
     with open(path, "rb") as f:
@@ -61,7 +68,9 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
 
     A parsed-columnar sidecar (<csv>.npz) is written on first load and reused
     while fresh — CSV stays the staging contract (reference layout), but the
-    hot path never re-parses text."""
+    hot path never re-parses text. The cold parse itself is native
+    (native/csv_loader.cpp: mmap + threaded float32 parse) when every column
+    is numeric; tables with string columns fall back to pandas."""
     import pandas as pd
 
     sidecar = path + ".npz"
@@ -72,17 +81,31 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
         except Exception:  # noqa: BLE001 — fall through to re-parse
             pass
 
+    from ..native import csv_parse_f32
+
+    parsed = csv_parse_f32(path)
+    if parsed is not None and bool(parsed[1].all()) and parsed[0].shape[1] >= 1:
+        mat, _ = parsed
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            columns = [c.strip() for c in f.readline().rstrip("\r\n").split(",")]
+        X, y = mat[:, :-1], mat[:, -1].astype(np.float64)
+        try:
+            np.savez(sidecar, X=X, y=y, columns=np.asarray(columns, object))
+        except OSError:
+            pass
+        return X, y, columns
+
     df = pd.read_csv(path)
     X_df = df.iloc[:, :-1]
     y = df.iloc[:, -1].to_numpy()
     X_cols = []
     for col in X_df.columns:
         series = X_df[col]
-        if series.dtype == object or str(series.dtype) == "category":
+        if pd.api.types.is_numeric_dtype(series):
+            X_cols.append(series.to_numpy(dtype=np.float32))
+        else:  # object / category / arrow-backed string: label-encode
             _, codes = np.unique(series.astype(str).to_numpy(), return_inverse=True)
             X_cols.append(codes.astype(np.float32))
-        else:
-            X_cols.append(series.to_numpy(dtype=np.float32))
     X = np.stack(X_cols, axis=1) if X_cols else np.zeros((len(df), 0), np.float32)
     try:
         np.savez(sidecar, X=X, y=y, columns=np.asarray(list(df.columns), object))

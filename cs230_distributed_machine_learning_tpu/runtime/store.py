@@ -83,12 +83,6 @@ class JobStore:
         }
         with self._lock:
             self._require_session(sid)["jobs"][job_id] = record
-            # a client-supplied job_id may reuse a finalized one; drop a
-            # stale already-set event so wait_job blocks on the new run, but
-            # keep an unset one — live waiters must wake on this run's finalize
-            stale = self._done_events.get((sid, job_id))
-            if stale is not None and stale.is_set():
-                del self._done_events[(sid, job_id)]
         self._journal({"op": "create_job", "sid": sid, "record": record})
 
     def update_subtask(

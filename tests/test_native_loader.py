@@ -1,0 +1,139 @@
+"""Native C++ CSV loader: correctness vs pandas, fallback behavior, and
+integration with the data plane (load_table / collect_csv_metadata)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cs230_distributed_machine_learning_tpu import native
+from cs230_distributed_machine_learning_tpu.data.datasets import (
+    collect_csv_metadata,
+    load_table,
+)
+
+pytestmark = pytest.mark.skipif(
+    native.get_lib() is None, reason="native toolchain unavailable"
+)
+
+
+def _write(tmp_path, name, df):
+    p = str(tmp_path / name)
+    df.to_csv(p, index=False)
+    return p
+
+
+def test_parse_matches_pandas_bitexact(tmp_path):
+    rng = np.random.RandomState(7)
+    df = pd.DataFrame(
+        rng.randn(5000, 12).astype(np.float32), columns=[f"c{i}" for i in range(12)]
+    )
+    df["label"] = rng.randint(0, 5, 5000)
+    p = _write(tmp_path, "num.csv", df)
+    mat, ok = native.csv_parse_f32(p)
+    assert ok.all()
+    ref = pd.read_csv(p).to_numpy(dtype=np.float32)
+    assert mat.shape == ref.shape
+    assert np.array_equal(mat, ref)
+
+
+def test_dims_and_metadata(tmp_path):
+    df = pd.DataFrame(np.arange(30.0).reshape(10, 3), columns=["a", "b", "c"])
+    p = _write(tmp_path, "d.csv", df)
+    assert native.csv_dims(p) == (10, 3)
+    meta = collect_csv_metadata(p)
+    assert meta["n_rows"] == 10 and meta["n_cols"] == 3
+
+
+def test_string_columns_flagged_and_load_table_falls_back(tmp_path):
+    df = pd.DataFrame(
+        {
+            "x": [1.0, 2.0, 3.0, 4.0],
+            "s": ["a", "b", "a", "c"],
+            "y": [0, 1, 0, 1],
+        }
+    )
+    p = _write(tmp_path, "mix.csv", df)
+    _, ok = native.csv_parse_f32(p)
+    assert not ok[1] and ok[0] and ok[2]
+    # load_table falls back to pandas label-encoding for the string column
+    X, y, cols = load_table(p)
+    assert X.shape == (4, 2)
+    assert set(np.unique(X[:, 1])) == {0.0, 1.0, 2.0}  # a/b/c codes
+    assert list(y) == [0, 1, 0, 1]
+
+
+def test_load_table_native_path_equals_pandas_path(tmp_path):
+    rng = np.random.RandomState(1)
+    df = pd.DataFrame(
+        rng.randn(200, 6).astype(np.float32), columns=[f"f{i}" for i in range(6)]
+    )
+    df["target"] = rng.randn(200).astype(np.float32)
+    p_native = _write(tmp_path, "a.csv", df)
+    p_pandas = _write(tmp_path, "b.csv", df)
+
+    X1, y1, cols1 = load_table(p_native)  # native fast path (all numeric)
+
+    real_parse = native.csv_parse_f32
+    try:
+        native.csv_parse_f32 = lambda _p: None  # force the pandas path
+        X2, y2, cols2 = load_table(p_pandas)
+    finally:
+        native.csv_parse_f32 = real_parse
+    assert np.array_equal(X1, X2)
+    assert np.allclose(y1.astype(np.float32), y2.astype(np.float32))
+    assert cols1 == cols2
+
+
+def test_missing_cells_are_nan_not_nonnumeric(tmp_path):
+    p = str(tmp_path / "m.csv")
+    with open(p, "w") as f:
+        f.write("a,b,y\n1,,0\n,2,1\n3,4,0\n")
+    mat, ok = native.csv_parse_f32(p)
+    assert ok.all()
+    assert np.isnan(mat[0, 1]) and np.isnan(mat[1, 0])
+    assert mat[2].tolist() == [3.0, 4.0, 0.0]
+
+
+def test_no_trailing_newline_and_crlf(tmp_path):
+    p = str(tmp_path / "t.csv")
+    with open(p, "wb") as f:
+        f.write(b"a,b\r\n1,2\r\n3,4")  # CRLF + no trailing newline
+    assert native.csv_dims(p) == (2, 2)
+    mat, ok = native.csv_parse_f32(p)
+    assert ok.all()
+    assert mat.tolist() == [[1.0, 2.0], [3.0, 4.0]]
+
+
+def test_page_multiple_file_no_trailing_newline(tmp_path):
+    """File whose size is an exact page multiple, ending in a digit with no
+    trailing newline: the last cell is flush against the mapping's end and
+    must not be read past (csv_loader.cpp parse_line bounded-copy path)."""
+    p = str(tmp_path / "page.csv")
+    page = 4096
+    body = b"a,b\n"
+    while page - len(body) - len(b"1,2\n") > 8:
+        body += b"1,2\n"
+    pad = page - len(body) - 2  # final line "1," + pad digits, no newline
+    body += b"1," + b"9" * pad
+    with open(p, "wb") as f:
+        f.write(body)
+    assert os.path.getsize(p) == page
+    mat, ok = native.csv_parse_f32(p)
+    assert ok.all()
+    assert mat[-1, 0] == 1.0 and mat[-1, 1] == float(b"9" * pad)
+
+
+def test_quoted_header_falls_back_to_pandas(tmp_path):
+    """A quoted header name containing a comma inflates the naive column
+    count; ragged data rows must be poisoned so load_table uses pandas."""
+    p = str(tmp_path / "q.csv")
+    with open(p, "w") as f:
+        f.write('x,"lat,lon",y\n1.0,2.5,0\n3.0,4.5,1\n')
+    _, ok = native.csv_parse_f32(p)
+    assert not ok.all()  # phantom column flagged non-numeric
+    X, y, cols = load_table(p)  # pandas path parses the quotes correctly
+    assert X.shape == (2, 2)
+    assert list(y) == [0, 1]
+    assert cols == ["x", "lat,lon", "y"]
