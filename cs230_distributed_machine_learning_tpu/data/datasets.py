@@ -87,13 +87,20 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
     if parsed is not None and bool(parsed[1].all()) and parsed[0].shape[1] >= 1:
         mat, _ = parsed
         with open(path, "r", encoding="utf-8", errors="replace") as f:
-            columns = [c.strip() for c in f.readline().rstrip("\r\n").split(",")]
+            columns = [
+                c.strip().strip('"').strip("'")
+                for c in f.readline().rstrip("\r\n").split(",")
+            ]
         X, y = mat[:, :-1], mat[:, -1].astype(np.float64)
-        try:
-            np.savez(sidecar, X=X, y=y, columns=np.asarray(columns, object))
-        except OSError:
-            pass
-        return X, y, columns
+        # f32 can't represent integer labels beyond 2^24 exactly — a label
+        # column in that range must take the pandas (int64) path or distinct
+        # class ids would silently collide
+        if not np.any(np.abs(y) >= 2**24):
+            try:
+                np.savez(sidecar, X=X, y=y, columns=np.asarray(columns, object))
+            except OSError:
+                pass
+            return X, y, columns
 
     df = pd.read_csv(path)
     X_df = df.iloc[:, :-1]

@@ -1,0 +1,147 @@
+"""Fused softmax-regression gradient as a Pallas TPU kernel.
+
+The LogisticRegression north-star config (1000-trial RandomizedSearchCV on
+Covertype, BASELINE.md) is HBM-bound on the pure-XLA path: every solver
+iteration materializes the softmax probabilities tensor
+``[trials, splits, n, classes]`` between the two matmuls, and with
+``classes`` (7) as the minor dimension the layout pads to 128 lanes —
+measured ~10 ms/iteration at 6.6 TF/s on v5e for a 64-trial x 6-split
+batch. This kernel fuses the whole gradient:
+
+    G[b] = A^T @ (w[b] * (softmax(A @ W[b]) - Y))     for all b = (trial, split)
+
+streaming row tiles of the shared design matrix A through VMEM. The
+probabilities never touch HBM.
+
+Packing: all trials' weight columns are packed into one matrix with a
+**class-major** column layout, ``col = (a * S + s) * Tw + t`` per
+128-trial block (a = class, s = split, t = trial-in-block). The grouped
+softmax over classes then becomes elementwise ops over ``c`` statically
+sliced ``[bm, S*Tw]`` tiles — no lane shuffles, no padding of the class
+dimension, and the matmul minor dimension is fully lane-packed.
+
+Replaces (in effect) the per-trial sklearn fit of the reference worker
+(``aws-prod/worker/worker.py:289-349``) for the LogisticRegression family;
+see models/logistic.py for the solver that drives it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: trials per weight block; the packed block width is ``c * S * TRIAL_BLOCK``
+TRIAL_BLOCK = 128
+
+
+def _grad_kernel(a_ref, w_ref, y_ref, wsp_ref, g_ref, *, c: int, S: int, Tw: int):
+    """One (weight-block, row-tile) grid step.
+
+    a_ref   [bm, dpp]      bf16  design-matrix row tile (shared by all trials)
+    w_ref   [1, dpp, NB]   bf16  packed weights, NB = c*S*Tw, class-major
+    y_ref   [bm, 1]        i32   labels for the tile rows
+    wsp_ref [bm, S]        f32   per-split {0,1} sample weights
+    g_ref   [1, dpp, NB]   f32   output: A^T (w (P - Y)), accumulated over row tiles
+    """
+    i = pl.program_id(1)
+    B = S * Tw
+    bm = a_ref.shape[0]
+
+    a = a_ref[:]
+    W = w_ref[0]
+    # logits for every (class, split, trial) column: one MXU pass, f32 out
+    logits = jnp.dot(a, W, preferred_element_type=jnp.float32)  # [bm, NB]
+
+    # per-(sample, split, trial) weight tile, broadcast from the S columns
+    wexp_parts = [
+        jnp.broadcast_to(wsp_ref[:, s : s + 1], (bm, Tw)) for s in range(S)
+    ]
+    wexp = jnp.concatenate(wexp_parts, axis=1)  # [bm, B]
+
+    # grouped softmax over the c class slices (elementwise; classes are
+    # separate [bm, B] tiles, so no cross-lane reductions are needed)
+    m = logits[:, 0:B]
+    for a_i in range(1, c):
+        m = jnp.maximum(m, logits[:, a_i * B : (a_i + 1) * B])
+    es = [jnp.exp(logits[:, a_i * B : (a_i + 1) * B] - m) for a_i in range(c)]
+    den = es[0]
+    for a_i in range(1, c):
+        den = den + es[a_i]
+    rden = 1.0 / den
+
+    yv = y_ref[:]  # [bm, 1]
+
+    @pl.when(i == 0)
+    def _init():
+        g_ref[0] = jnp.zeros_like(g_ref[0])
+
+    # per class: residual tile and its gradient contribution (7 small dots
+    # instead of one concat keeps everything statically sliced)
+    for a_i in range(c):
+        onehot = (yv == a_i).astype(jnp.float32)  # [bm, 1] broadcasts
+        r = ((es[a_i] * rden - onehot) * wexp).astype(jnp.bfloat16)  # [bm, B]
+        g_a = jax.lax.dot_general(
+            a,
+            r,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [dpp, B]
+        g_ref[0, :, a_i * B : (a_i + 1) * B] += g_a
+
+
+@functools.partial(jax.jit, static_argnames=("c", "S", "Tw", "bm", "interpret"))
+def packed_softmax_grad(
+    Ab, W3, y2, WSP, *, c: int, S: int, Tw: int = TRIAL_BLOCK, bm: int = 256, interpret: bool = False
+):
+    """G3[wb] = A^T @ (w * (softmax(A @ W3[wb]) - Y)) for every packed column.
+
+    Ab  [n_pad, dpp]       bf16, n_pad % bm == 0 (pad rows must have w == 0)
+    W3  [n_wb, dpp, NB]    bf16, NB == c*S*Tw, column = (a*S + s)*Tw + t
+    y2  [n_pad, 1]         i32
+    WSP [n_pad, S]         f32
+    returns G3 [n_wb, dpp, NB] f32
+    """
+    n_pad, dpp = Ab.shape
+    n_wb, _, NB = W3.shape
+    assert NB == c * S * Tw, (NB, c, S, Tw)
+    assert n_pad % bm == 0, (n_pad, bm)
+
+    grid = (n_wb, n_pad // bm)
+    kernel = functools.partial(_grad_kernel, c=c, S=S, Tw=Tw)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, dpp), lambda wb, i: (i, 0)),
+            pl.BlockSpec((1, dpp, NB), lambda wb, i: (wb, 0, 0)),
+            pl.BlockSpec((bm, 1), lambda wb, i: (i, 0)),
+            pl.BlockSpec((bm, S), lambda wb, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, dpp, NB), lambda wb, i: (wb, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_wb, dpp, NB), jnp.float32),
+        interpret=interpret,
+    )(Ab, W3, y2, WSP)
+
+
+def packed_softmax_grad_reference(Ab, W3, y2, WSP, *, c: int, S: int, Tw: int = TRIAL_BLOCK):
+    """Pure-XLA reference of the kernel (same packing), for parity tests."""
+    n_pad, dpp = Ab.shape
+    n_wb, _, NB = W3.shape
+    B = S * Tw
+    A = Ab.astype(jnp.float32)
+    y = y2[:, 0]
+
+    def one_block(W):  # [dpp, NB]
+        logits = A @ W  # [n, NB]
+        L = logits.reshape(n_pad, c, B)
+        P = jax.nn.softmax(L, axis=1)
+        onehot = jax.nn.one_hot(y, c, dtype=jnp.float32)  # [n, c]
+        wexp = jnp.repeat(WSP, Tw, axis=1)  # [n, B] (split-major blocks)
+        R = (P - onehot[:, :, None]) * wexp[:, None, :]
+        return jnp.einsum("nd,ncb->dcb", A, R).reshape(dpp, NB)
+
+    return jax.vmap(one_block)(W3)

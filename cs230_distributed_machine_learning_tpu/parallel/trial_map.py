@@ -18,6 +18,7 @@ scalar results — replacing the reference's per-trial Kafka round trips.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -91,13 +92,56 @@ def run_trials(
             static = kernel.bucket_static(static, [hypers[i] for i in idxs])
 
         hyper_names = sorted(hypers[idxs[0]].keys())
-        mem_cap = _memory_chunk_cap(kernel, n, d, static, plan.n_splits, n_dev)
-        chunk = min(max_trials_per_batch, mem_cap, pad_to_multiple(len(idxs), n_dev))
-        chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
-        fn, fresh_compile = _get_compiled(
-            kernel, static_key, static, mesh, trial_axis, data, plan, chunk, bool(hyper_names), X
-        )
+        # Kernels with a fused batched path (e.g. the Pallas packed
+        # LogisticRegression fit, models/logistic.py) take over the whole
+        # chunk: one jitted call = fit scan + eval, with its own (larger)
+        # chunk geometry. Single-device only — the trial mesh axis is
+        # handled by the generic sharded path.
+        batched_fn = None
+        if (
+            hasattr(kernel, "build_batched_fn")
+            and (mesh is None or int(np.prod(list(mesh.shape.values()))) == 1)
+        ):
+            Tw = getattr(kernel, "batched_trial_multiple", 128)
+            cap = getattr(kernel, "batched_chunk_cap", 1024)
+            bchunk = max(Tw, min(cap, pad_to_multiple(len(idxs), Tw)))
+            batched_fn = kernel.build_batched_fn(
+                static=static,
+                n=n,
+                d=d,
+                n_classes=data.n_classes,
+                n_splits=plan.n_splits,
+                chunk=bchunk,
+            )
+
+        if batched_fn is not None:
+            chunk = bchunk
+            cache_key = (
+                "batched",
+                # interpret mode is baked into the closure at build time, so
+                # it must be part of the key or a flip of the env var would
+                # silently reuse the wrong executable
+                os.environ.get("CS230_PALLAS_INTERPRET", ""),
+                kernel.name,
+                tuple(sorted((k, str(v)) for k, v in static.items())),
+                data.X.shape,
+                data.n_classes,
+                plan.n_splits,
+                chunk,
+            )
+            fresh_compile = cache_key not in _compiled_cache
+            if fresh_compile:
+                _compiled_cache[cache_key] = jax.jit(batched_fn)
+            fn = _compiled_cache[cache_key]
+        else:
+            mem_cap = _memory_chunk_cap(kernel, n, d, static, plan.n_splits, n_dev)
+            chunk = min(max_trials_per_batch, mem_cap, pad_to_multiple(len(idxs), n_dev))
+            chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
+
+            fn, fresh_compile = _get_compiled(
+                kernel, static_key, static, mesh, trial_axis, data, plan, chunk, bool(hyper_names), X
+            )
 
         for start in range(0, len(idxs), chunk):
             batch_idx = idxs[start : start + chunk]
