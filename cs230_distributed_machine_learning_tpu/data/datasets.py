@@ -28,6 +28,10 @@ import numpy as np
 from ..models.base import TrialData
 from ..utils.config import get_config
 
+# parsed-columnar sidecar format: bump when the parse semantics change so
+# stale blobs (e.g. pre-dating the 2^24 f32-label guard) are re-parsed
+_SIDECAR_VERSION = 2
+
 
 def dataset_dir(dataset_id: str, root: Optional[str] = None) -> str:
     root = root or get_config().storage.datasets_dir
@@ -77,7 +81,10 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
     if os.path.exists(sidecar) and os.path.getmtime(sidecar) >= os.path.getmtime(path):
         try:
             z = np.load(sidecar, allow_pickle=True)
-            return z["X"], z["y"], list(z["columns"])
+            # format version gate: v2 added the 2^24 f32-label-precision
+            # guard, so unversioned (pre-guard) sidecars must re-parse
+            if int(z["version"]) >= _SIDECAR_VERSION:
+                return z["X"], z["y"], list(z["columns"])
         except Exception:  # noqa: BLE001 — fall through to re-parse
             pass
 
@@ -97,7 +104,13 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
         # class ids would silently collide
         if not np.any(np.abs(y) >= 2**24):
             try:
-                np.savez(sidecar, X=X, y=y, columns=np.asarray(columns, object))
+                np.savez(
+                    sidecar,
+                    X=X,
+                    y=y,
+                    columns=np.asarray(columns, object),
+                    version=_SIDECAR_VERSION,
+                )
             except OSError:
                 pass
             return X, y, columns
@@ -115,7 +128,13 @@ def load_table(path: str) -> Tuple[np.ndarray, np.ndarray, list]:
             X_cols.append(codes.astype(np.float32))
     X = np.stack(X_cols, axis=1) if X_cols else np.zeros((len(df), 0), np.float32)
     try:
-        np.savez(sidecar, X=X, y=y, columns=np.asarray(list(df.columns), object))
+        np.savez(
+            sidecar,
+            X=X,
+            y=y,
+            columns=np.asarray(list(df.columns), object),
+            version=_SIDECAR_VERSION,
+        )
     except OSError:
         pass
     return X, y, list(df.columns)

@@ -45,13 +45,17 @@ def quantile_bins(X: np.ndarray, n_bins: int) -> np.ndarray:
     return np.ascontiguousarray(edges.T.astype(np.float32))  # [d, n_bins-1]
 
 
-def bin_data(X, edges) -> jnp.ndarray:
-    """Map raw features to bin codes with per-column searchsorted."""
-    X = jnp.asarray(X, jnp.float32)
-    edges = jnp.asarray(edges, jnp.float32)
+@jax.jit
+def _bin_data_impl(X, edges):
     return jax.vmap(
         lambda col, e: jnp.searchsorted(e, col, side="right"), in_axes=(1, 0), out_axes=1
     )(X, edges).astype(jnp.int32)
+
+
+def bin_data(X, edges) -> jnp.ndarray:
+    """Map raw features to bin codes with per-column searchsorted (jitted:
+    one cached executable per dataset shape, not per-primitive dispatches)."""
+    return _bin_data_impl(jnp.asarray(X, jnp.float32), jnp.asarray(edges, jnp.float32))
 
 
 def build_tree(

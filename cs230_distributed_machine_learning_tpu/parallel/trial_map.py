@@ -30,9 +30,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.base import ModelKernel, TrialData
 from ..ops.folds import SplitPlan
+from ..utils.aot_cache import aot_jit
 from .mesh import pad_to_multiple
 
 _compiled_cache: Dict[Any, Any] = {}
+
+
+def _sds(a):
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def _example_args(X, y, TW, EW, hyper_names, chunk):
+    """Shape/dtype skeleton of one dispatch — drives the AOT export trace."""
+    hyper = {
+        k: jax.ShapeDtypeStruct((chunk,), jnp.float32)
+        for k in (hyper_names or ["_pad"])
+    }
+    return (jax.tree_util.tree_map(_sds, X), _sds(y), _sds(TW), _sds(EW), hyper)
+
+
+def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
+    leaves, treedef = jax.tree_util.tree_flatten(X)
+    x_sig = (
+        str(treedef),
+        tuple((tuple(a.shape), str(a.dtype)) for a in leaves),
+    )
+    return (
+        kernel.name,
+        tuple(sorted((k, str(v)) for k, v in static.items())),
+        x_sig,
+        n_classes,
+        n_splits,
+        chunk,
+        tuple(hyper_names),
+        os.environ.get("CS230_PALLAS_INTERPRET", ""),
+    )
 
 
 @dataclasses.dataclass
@@ -117,22 +149,16 @@ def run_trials(
 
         if batched_fn is not None:
             chunk = bchunk
-            cache_key = (
-                "batched",
-                # interpret mode is baked into the closure at build time, so
-                # it must be part of the key or a flip of the env var would
-                # silently reuse the wrong executable
-                os.environ.get("CS230_PALLAS_INTERPRET", ""),
-                kernel.name,
-                tuple(sorted((k, str(v)) for k, v in static.items())),
-                data.X.shape,
-                data.n_classes,
-                plan.n_splits,
-                chunk,
+            # one key for both layers: _aot_key carries everything that
+            # determines the executable (incl. the interpret-mode env var,
+            # which is baked into the closure at build time)
+            cache_key = ("batched",) + _aot_key(
+                kernel, static, X, data.n_classes, plan.n_splits, chunk, hyper_names
             )
             fresh_compile = cache_key not in _compiled_cache
             if fresh_compile:
-                _compiled_cache[cache_key] = jax.jit(batched_fn)
+                example = _example_args(X, y, TW, EW, hyper_names, chunk)
+                _compiled_cache[cache_key], _ = aot_jit(batched_fn, cache_key, example)
             fn = _compiled_cache[cache_key]
         else:
             mem_cap = _memory_chunk_cap(kernel, n, d, static, plan.n_splits, n_dev)
@@ -140,7 +166,8 @@ def run_trials(
             chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
             fn, fresh_compile = _get_compiled(
-                kernel, static_key, static, mesh, trial_axis, data, plan, chunk, bool(hyper_names), X
+                kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
+                hyper_names, X, y, TW, EW,
             )
 
         for start in range(0, len(idxs), chunk):
@@ -237,7 +264,15 @@ def _memory_chunk_cap(kernel, n, d, static, n_splits, n_dev) -> int:
     return max(n_dev, int(budget_mb / per_trial_mb))
 
 
-def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk, has_hyper, X_proto=None):
+def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
+                  hyper_names, X_proto=None, y=None, TW=None, EW=None):
+    has_hyper = bool(hyper_names)
+    # a 1-device mesh is compilation-equivalent to no mesh: drop the
+    # NamedShardings so the executable is AOT-exportable and its disk key is
+    # mesh-independent (single chip is the bench/measure environment)
+    n_mesh_dev = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
+    if n_mesh_dev == 1:
+        mesh = None
     cache_key = (
         kernel.name,
         tuple(sorted((k, str(v)) for k, v in static.items())),
@@ -297,7 +332,14 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
                 out_shardings=trial_sharded,
             )
     else:
-        fn = jax.jit(batched)
+        X_ex = X_proto if X_proto is not None else jax.ShapeDtypeStruct(
+            data.X.shape, jnp.float32
+        )
+        example = _example_args(X_ex, y, TW, EW, hyper_names, chunk)
+        disk_key = ("generic",) + _aot_key(
+            kernel, static, X_ex, data.n_classes, plan.n_splits, chunk, hyper_names
+        )
+        fn, _ = aot_jit(batched, disk_key, example)
     _compiled_cache[cache_key] = fn
     return fn, True
 

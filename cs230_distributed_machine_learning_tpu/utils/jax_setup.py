@@ -27,6 +27,8 @@ def setup_jax(cache_dir: str | None = None) -> None:
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # cache every compile: even sub-second compiles cost a backend RPC
+        # round trip per fresh process (large on tunneled/remote devices)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     except Exception:  # noqa: BLE001 — older jax or read-only fs: run uncached
         pass
