@@ -29,6 +29,7 @@ the riskiest parity item and this is the deliberate trade.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
@@ -60,16 +61,18 @@ class _TreeBase(ModelKernel):
     _mf_default: Any = 1.0
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
+        n_bins = int(static.get("n_bins", 128))
+        n_bins = min(n_bins, max(8, n))
         depth = static.get("max_depth")
         if depth is None:
             # sklearn grows to purity; a tree on n samples can't use more than
             # ~log2(n) useful levels, so cap there — deeper levels would be
-            # all pass-through nodes, paid for in compile time
+            # all pass-through nodes, paid for in compile time. (Dispatch
+            # time at large n x depth is bounded by the chunked-fit protocol
+            # below, not by shrinking the tree.)
             depth = min(_DEPTH_CAP, max(3, int(np.ceil(np.log2(max(n, 8)))) - 2))
         else:
             depth = min(int(depth), _DEPTH_CAP)
-        n_bins = int(static.get("n_bins", 128))
-        n_bins = min(n_bins, max(8, n))
         mf = _resolve_max_features(static.get("max_features"), d, self._mf_default)
         msl = static.get("min_samples_leaf", 1)
         if isinstance(msl, float) and msl < 1:
@@ -103,10 +106,17 @@ class _TreeBase(ModelKernel):
 
 
 def _bootstrap_counts(key, w, n):
-    """Exact bootstrap: n draws with replacement from rows where w>0."""
-    logits = jnp.where(w > 0, 0.0, -jnp.inf)
-    idx = jax.random.categorical(key, logits, shape=(n,))
-    return jax.ops.segment_sum(jnp.ones((n,), jnp.float32), idx, num_segments=n)
+    """Exact bootstrap: n draws with replacement from rows where w>0.
+
+    Uniform-over-active-rows multinomial via inverse-CDF searchsorted —
+    O(n log n), unlike jax.random.categorical whose gumbel matrix is
+    [draws, categories] = n x n (54 GB at Covertype scale)."""
+    active = (w > 0).astype(jnp.int32)
+    caw = jnp.cumsum(active)
+    n_active = caw[-1]
+    targets = jax.random.randint(key, (n,), 1, jnp.maximum(n_active, 1) + 1)
+    rows = jnp.searchsorted(caw, targets, side="left")
+    return jax.ops.segment_sum(jnp.ones((n,), jnp.float32), rows, num_segments=n)
 
 
 class _RandomForestBase(_TreeBase):
@@ -129,32 +139,104 @@ class _RandomForestBase(_TreeBase):
         "monotonic_cst": None,
     }
 
+    def _one_tree(self, xb, S, C, static, key):
+        boot_key, feat_key = jax.random.split(key)
+        if static.get("bootstrap", True):
+            counts = _bootstrap_counts(boot_key, C, xb.shape[0])
+        else:
+            counts = (C > 0).astype(jnp.float32)
+        return build_tree(
+            xb,
+            S * counts[:, None],
+            C * counts,
+            depth=static["_depth"],
+            n_bins=static["_n_bins"],
+            min_samples_leaf=static["_msl"],
+            max_features=static["_mf"],
+            key=feat_key,
+            # classification stats are small-integer counts x 0/1 one-hots —
+            # exact in bf16, so the fast MXU path loses nothing; regression
+            # stats are continuous y*w sums and need full f32
+            precision=(
+                jax.lax.Precision.DEFAULT
+                if self.task == "classification"
+                else jax.lax.Precision.HIGHEST
+            ),
+        )
+
     def _fit_forest(self, xb, S, C, static):
-        depth = static["_depth"]
-        n_bins = static["_n_bins"]
-        n = xb.shape[0]
         n_trees = int(static.get("n_estimators", 100))
         base_key = jax.random.PRNGKey(static["_seed"])
-
-        def one_tree(key):
-            boot_key, feat_key = jax.random.split(key)
-            if static.get("bootstrap", True):
-                counts = _bootstrap_counts(boot_key, C, n)
-            else:
-                counts = (C > 0).astype(jnp.float32)
-            return build_tree(
-                xb,
-                S * counts[:, None],
-                C * counts,
-                depth=depth,
-                n_bins=n_bins,
-                min_samples_leaf=static["_msl"],
-                max_features=static["_mf"],
-                key=feat_key,
-            )
-
         keys = jax.random.split(base_key, n_trees)
-        return jax.lax.map(one_tree, keys)  # stacked tree pytree
+        return jax.lax.map(lambda k: self._one_tree(xb, S, C, static, k), keys)
+
+    # ---- chunked-fit protocol (parallel/trial_map.py chunked path) ----
+    # A forest fit on a large dataset is one long sequential device program
+    # (lax.map over trees); splitting the trees across several dispatches
+    # bounds single-dispatch device time (remote-device RPC deadlines) and
+    # lets full-depth trees run at any dataset size. Trees are independent,
+    # so the cross-dispatch state is just the running sum of per-tree leaf
+    # predictions for every row; eval finalizes the soft-vote mean.
+
+    def chunked_plan(self, static, n, d, n_classes, n_splits):
+        chunk_macs = float(os.environ.get("CS230_TREE_CHUNK_MACS", 4e13))
+        trees = int(static.get("n_estimators", 100))
+        kk = max(int(n_classes), 2) + 1 if self.task == "classification" else 2
+        depth = static["_depth"]
+        macs = (
+            float(max(n_splits, 1)) * trees * n * (2 ** max(depth - 1, 0))
+            * kk * d * static["_n_bins"]
+        )
+        n_chunks = int(np.ceil(macs / chunk_macs))
+        if n_chunks <= 1:
+            return None
+        trees_per_chunk = int(np.ceil(trees / n_chunks))
+        return {"n_chunks": int(np.ceil(trees / trees_per_chunk)),
+                "trees_per_chunk": trees_per_chunk}
+
+    def _stat_matrix(self, y, w, static):
+        if self.task == "classification":
+            c = max(int(static["_n_classes"]), 2)
+            return jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None], c
+        return (y.astype(jnp.float32) * w)[:, None], 1
+
+    def chunk_init(self, X, y, w, hyper, static):
+        xb = X["xb"] if isinstance(X, dict) else X
+        _, k = self._stat_matrix(y, w, static)
+        return jnp.zeros((xb.shape[0], k), jnp.float32)
+
+    def chunk_step(self, X, y, w, hyper, static, chunk_idx, state, plan):
+        xb = X["xb"] if isinstance(X, dict) else X
+        S, _ = self._stat_matrix(y, w.astype(jnp.float32), static)
+        C = w.astype(jnp.float32)
+        n_trees = int(static.get("n_estimators", 100))
+        g = plan["trees_per_chunk"]
+        base_key = jax.random.PRNGKey(static["_seed"])
+
+        def one(carry, i):
+            t = chunk_idx * g + i
+            key = jax.random.fold_in(base_key, t)
+            tree = self._one_tree(xb, S, C, static, key)
+            val = predict_tree(xb, tree, static["_depth"])  # [n, k]
+            live = (t < n_trees).astype(jnp.float32)
+            return carry + live * val, None
+
+        state, _ = jax.lax.scan(one, state, jnp.arange(g))
+        return state
+
+    def chunk_eval(self, X, y, w_eval, hyper, static, state):
+        from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+
+        n_trees = int(static.get("n_estimators", 100))
+        mean = state / float(n_trees)
+        if self.task == "classification":
+            pred = jnp.argmax(mean, axis=-1).astype(jnp.int32)
+            return {"score": weighted_accuracy(y, pred, w_eval)}
+        pred = mean[:, 0]
+        return {
+            "score": weighted_r2(y, pred, w_eval),
+            "mse": weighted_mse(y, pred, w_eval),
+        }
 
     def _forest_leaf_mean(self, params, xq, static):
         trees = params["trees"]

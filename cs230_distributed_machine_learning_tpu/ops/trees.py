@@ -58,6 +58,54 @@ def bin_data(X, edges) -> jnp.ndarray:
     return _bin_data_impl(jnp.asarray(X, jnp.float32), jnp.asarray(edges, jnp.float32))
 
 
+_HIST_ROW_CHUNK = 16384
+
+
+def _level_histogram(local, xb, SC, n_nodes: int, n_bins: int, precision=None):
+    """[n_nodes, d, n_bins, kk] histogram of per-sample stats ``SC`` grouped
+    by (tree node, feature, bin code).
+
+    Computed as (one_hot(node) ⊗ SC)ᵀ @ one_hot(bins) over row chunks: two
+    0/1 one-hot operands make the contraction a pure MXU matmul, replacing
+    segment-sum scatters (which serialize on TPU and dominated tree-fit time
+    ~10-30x). Rows stream through a lax.scan so peak memory is
+    O(row_chunk · (n_nodes·kk + d·n_bins)) regardless of n.
+    """
+    n, d = xb.shape
+    kk = SC.shape[1]
+    rc = min(_HIST_ROW_CHUNK, n)
+    n_pad = ((n + rc - 1) // rc) * rc
+    if n_pad != n:
+        # padded rows carry zero stats — they land in node 0/bin 0 cells
+        # with zero contribution
+        local = jnp.pad(local, (0, n_pad - n))
+        xb = jnp.pad(xb, ((0, n_pad - n), (0, 0)))
+        SC = jnp.pad(SC, ((0, n_pad - n), (0, 0)))
+
+    def body(H, start):
+        lb = jax.lax.dynamic_slice(local, (start,), (rc,))
+        xbb = jax.lax.dynamic_slice(xb, (start, 0), (rc, d))
+        SCb = jax.lax.dynamic_slice(SC, (start, 0), (rc, kk))
+        N = jax.nn.one_hot(lb, n_nodes, dtype=SCb.dtype)  # [rc, nodes]
+        T1 = (N[:, :, None] * SCb[:, None, :]).reshape(rc, n_nodes * kk)
+        B = (
+            xbb[:, :, None] == jnp.arange(n_bins, dtype=xbb.dtype)[None, None, :]
+        ).astype(SCb.dtype).reshape(rc, d * n_bins)
+        H = H + jnp.dot(
+            T1.T,
+            B,
+            precision=precision,
+            preferred_element_type=jnp.float32,
+        )
+        return H, None
+
+    H0 = jnp.zeros((n_nodes * kk, d * n_bins), jnp.float32)
+    starts = jnp.arange(0, n_pad, rc, dtype=jnp.int32)
+    H, _ = jax.lax.scan(body, H0, starts)
+    # rows are node-major over kk; cols feature-major over bins
+    return H.reshape(n_nodes, kk, d, n_bins).transpose(0, 2, 3, 1)
+
+
 def build_tree(
     xb,
     S,
@@ -68,6 +116,7 @@ def build_tree(
     min_samples_leaf: float = 1.0,
     max_features: Optional[int] = None,
     key=None,
+    precision=jax.lax.Precision.HIGHEST,
 ) -> Dict[str, jnp.ndarray]:
     """Fit one tree.
 
@@ -76,6 +125,11 @@ def build_tree(
     (counts for RF, hessians for boosting; 0 = sample not in this fit).
     Returns {"split_feat" [2^depth-1], "split_bin" [2^depth-1],
     "leaf_val" [2^depth, k]}.
+
+    precision: matmul precision for the histogram contraction. HIGHEST
+    (default) for float-valued stats (boosting gradients); integer-valued
+    stats (RF one-hot counts, exact in bf16) may pass DEFAULT for ~3x
+    faster histograms with bit-identical sums.
     """
     n, d = xb.shape
     k = S.shape[1]
@@ -88,20 +142,32 @@ def build_tree(
     node = jnp.zeros((n,), jnp.int32)
     feat_ids = jnp.arange(d, dtype=jnp.int32)
 
+    SC = jnp.concatenate([S, C[:, None]], axis=1)  # [n, k+1] stats+count
+
+    H_prev = None
     for level in range(depth):
         n_nodes = 2**level
         base = n_nodes - 1
         local = node - base
-        # histograms: [n_nodes, d, n_bins] segments
-        seg = (local[:, None] * d + feat_ids[None, :]) * n_bins + xb  # [n, d]
-        seg = seg.reshape(-1)
-        n_seg = n_nodes * d * n_bins
-        Sh = jax.ops.segment_sum(
-            jnp.repeat(S[:, None, :], d, axis=1).reshape(-1, k), seg, num_segments=n_seg
-        ).reshape(n_nodes, d, n_bins, k)
-        Ch = jax.ops.segment_sum(
-            jnp.repeat(C[:, None], d, axis=1).reshape(-1), seg, num_segments=n_seg
-        ).reshape(n_nodes, d, n_bins)
+        # histograms [n_nodes, d, n_bins, k+1] via one-hot matmuls on the
+        # MXU (node/bin membership as 0/1 operands contracted over rows) —
+        # TPU scatters serialize, matmuls don't. Levels past the root use
+        # the subtraction trick: build only LEFT children (half the node
+        # dim), right = parent − left (exact for integer stats; gains clamp
+        # the f32 cancellation tails) — halves total histogram work.
+        if level == 0:
+            H = _level_histogram(local, xb, SC, n_nodes, n_bins, precision)
+        else:
+            went_left = (local % 2 == 0).astype(SC.dtype)
+            H_left = _level_histogram(
+                local // 2, xb, SC * went_left[:, None], n_nodes // 2, n_bins, precision
+            )
+            H = jnp.stack([H_left, H_prev - H_left], axis=1).reshape(
+                n_nodes, d, n_bins, k + 1
+            )
+        H_prev = H
+        Sh = H[..., :k]
+        Ch = jnp.maximum(H[..., k], 0.0)
 
         Scum = jnp.cumsum(Sh, axis=2)  # left stats for split at bin b
         Ccum = jnp.cumsum(Ch, axis=2)

@@ -124,6 +124,23 @@ def run_trials(
             static = kernel.bucket_static(static, [hypers[i] for i in idxs])
 
         hyper_names = sorted(hypers[idxs[0]].keys())
+        single_device = mesh is None or int(np.prod(list(mesh.shape.values()))) == 1
+
+        # Kernels with a chunked-fit protocol (tree ensembles) split one
+        # trial's fit across several bounded-time dispatches — full-depth
+        # forests at any dataset size without multi-minute single RPCs.
+        chunk_plan = None
+        if single_device and hasattr(kernel, "chunked_plan"):
+            chunk_plan = kernel.chunked_plan(static, n, d, data.n_classes, plan.n_splits)
+        if chunk_plan:
+            ct, rt, nd = _run_chunked(
+                kernel, static, X, y, TW, EW, hypers, idxs, results,
+                plan, chunk_plan, hyper_names, data,
+            )
+            compile_time += ct
+            run_time += rt
+            dispatches += nd
+            continue
 
         # Kernels with a fused batched path (e.g. the Pallas packed
         # LogisticRegression fit, models/logistic.py) take over the whole
@@ -131,10 +148,7 @@ def run_trials(
         # chunk geometry. Single-device only — the trial mesh axis is
         # handled by the generic sharded path.
         batched_fn = None
-        if (
-            hasattr(kernel, "build_batched_fn")
-            and (mesh is None or int(np.prod(list(mesh.shape.values()))) == 1)
-        ):
+        if hasattr(kernel, "build_batched_fn") and single_device:
             Tw = getattr(kernel, "batched_trial_multiple", 128)
             cap = getattr(kernel, "batched_chunk_cap", 1024)
             bchunk = max(Tw, min(cap, pad_to_multiple(len(idxs), Tw)))
@@ -342,6 +356,116 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
         fn, _ = aot_jit(batched, disk_key, example)
     _compiled_cache[cache_key] = fn
     return fn, True
+
+
+def _run_chunked(
+    kernel, static, X, y, TW, EW, hypers, idxs, results,
+    plan: SplitPlan, chunk_plan: Dict[str, Any], hyper_names, data,
+):
+    """Run one bucket through the kernel's chunked-fit protocol.
+
+    init -> n_chunks x step -> eval, all vmapped over (trials, splits); the
+    cross-dispatch state is the kernel's accumulator pytree (e.g. summed
+    per-tree predictions for a forest). Dispatches are NOT synchronized
+    between steps — they pipeline on the device queue; only eval's output is
+    fetched. Returns (compile_time, run_time, n_dispatches).
+    """
+    n_chunks = int(chunk_plan["n_chunks"])
+
+    def _h(hyper):
+        return hyper if hyper_names else {}
+
+    def init_b(X, y, TW, EW, hyper):
+        return jax.vmap(
+            lambda tw: kernel.chunk_init(X, y, tw, _h(hyper), static)
+        )(TW)
+
+    def step_b(X, y, TW, EW, hyper, ci, state):
+        return jax.vmap(
+            lambda tw, st: kernel.chunk_step(
+                X, y, tw, _h(hyper), static, ci, st, chunk_plan
+            )
+        )(TW, state)
+
+    def eval_b(X, y, TW, EW, hyper, state):
+        return jax.vmap(
+            lambda ew, st: kernel.chunk_eval(X, y, ew, _h(hyper), static, st)
+        )(EW, state)
+
+    vinit = jax.vmap(init_b, in_axes=(None, None, None, None, 0))
+    vstep = jax.vmap(step_b, in_axes=(None, None, None, None, 0, None, 0))
+    veval = jax.vmap(eval_b, in_axes=(None, None, None, None, 0, 0))
+
+    # trial-chunk size: bounded by BOTH the cross-dispatch state memory and
+    # the kernel's per-trial working-set estimate (histogram buffers etc. —
+    # the same cap the non-chunked path consults)
+    state_mb = 4.0 * data.n_samples * max(data.n_classes, 1) * plan.n_splits / 1e6
+    mem_cap = _memory_chunk_cap(kernel, data.n_samples, data.n_features, static,
+                                plan.n_splits, 1)
+    chunk = max(1, min(len(idxs), mem_cap,
+                       int(0.25 * _device_memory_mb() / max(state_mb, 1.0)), 64))
+
+    base_key_parts = _aot_key(
+        kernel, static, X, data.n_classes, plan.n_splits, chunk, hyper_names
+    ) + (n_chunks, chunk_plan.get("trees_per_chunk"))
+    cache_tag = ("chunked",) + base_key_parts
+    compile_time = 0.0
+    run_time = 0.0
+    dispatches = 0
+    fresh = cache_tag not in _compiled_cache
+    if fresh:
+        hyper_ex = {
+            k: jax.ShapeDtypeStruct((chunk,), jnp.float32)
+            for k in (hyper_names or ["_pad"])
+        }
+        Xe = jax.tree_util.tree_map(_sds, X)
+        args_ie = (Xe, _sds(y), _sds(TW), _sds(EW), hyper_ex)
+        fi, _ = aot_jit(vinit, ("chunk_init",) + base_key_parts, args_ie)
+        state_ex = jax.eval_shape(vinit, X, y, TW, EW, hyper_ex)
+        fs, _ = aot_jit(
+            vstep,
+            ("chunk_step",) + base_key_parts,
+            args_ie + (jax.ShapeDtypeStruct((), jnp.int32),)
+            + (jax.tree_util.tree_map(_sds, state_ex),),
+        )
+        fe, _ = aot_jit(
+            veval,
+            ("chunk_eval",) + base_key_parts,
+            args_ie + (jax.tree_util.tree_map(_sds, state_ex),),
+        )
+        _compiled_cache[cache_tag] = (fi, fs, fe)
+    fi, fs, fe = _compiled_cache[cache_tag]
+
+    for start in range(0, len(idxs), chunk):
+        batch_idx = idxs[start : start + chunk]
+        if hyper_names:
+            hyper_arg = {
+                k: jnp.asarray(
+                    [hypers[gi][k] for gi in batch_idx]
+                    + [hypers[batch_idx[-1]][k]] * (chunk - len(batch_idx)),
+                    jnp.float32,
+                )
+                for k in hyper_names
+            }
+        else:
+            hyper_arg = {"_pad": jnp.zeros((chunk,), jnp.float32)}
+
+        t0 = time.perf_counter()
+        state = fi(X, y, TW, EW, hyper_arg)
+        for ci in range(n_chunks):
+            state = fs(X, y, TW, EW, hyper_arg, jnp.int32(ci), state)
+        out = fe(X, y, TW, EW, hyper_arg, state)
+        out = jax.tree_util.tree_map(np.asarray, jax.block_until_ready(out))
+        dt = time.perf_counter() - t0
+        if fresh and start == 0:
+            compile_time += dt
+        run_time += dt
+        dispatches += 2 + n_chunks
+
+        for j, gi in enumerate(batch_idx):
+            results[gi] = _postprocess(out, j, plan, kernel.task)
+
+    return compile_time, run_time, dispatches
 
 
 def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str) -> Dict[str, Any]:

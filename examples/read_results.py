@@ -1,0 +1,57 @@
+"""Results reader: fetch a prior job's metrics from a running coordinator.
+
+Parity with the reference's manual results reader (`demo_results.py:6-19`:
+paste session/job ids from an earlier run, GET /metrics, print per-subtask
+accuracy/time). Works against a coordinator server whose job store journal
+has the job (jobs survive coordinator restarts via the JSONL journal —
+something the reference's Redis-backed master never supported for its
+in-flight consumer threads).
+
+    python examples/read_results.py --url http://localhost:5001 \
+        --session <sid> --job <jid>
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://localhost:5001")
+    parser.add_argument("--session", required=True)
+    parser.add_argument("--job", required=True)
+    args = parser.parse_args()
+
+    def get(path):
+        with urllib.request.urlopen(f"{args.url}{path}") as r:
+            return json.load(r)
+
+    status = get(f"/check_status/{args.session}/{args.job}")
+    print(f"job_status: {status.get('job_status')}")
+
+    metrics = get(f"/metrics/{args.session}/{args.job}")
+    rows = metrics if isinstance(metrics, list) else metrics.get("metrics", [])
+    for m in rows:
+        subtask = m.get("subtask_id", "?")
+        algo = m.get("algo", m.get("model_type", "?"))
+        dur = None
+        if m.get("started_at") and m.get("finished_at"):
+            dur = m["finished_at"] - m["started_at"]
+        dur_txt = f"{dur:.3f}s" if dur is not None else "n/a"
+        print(f"  {subtask}: {algo}  batch_time={dur_txt}")
+
+    result = status.get("job_result") or {}
+    best = result.get("best_result")
+    if best:
+        print("best:", json.dumps(
+            {k: best[k] for k in ("search_params", "mean_cv_score", "accuracy", "r2_score")
+             if k in best}))
+    failed = result.get("failed") or []
+    if failed:
+        print(f"failed subtasks: {len(failed)}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
