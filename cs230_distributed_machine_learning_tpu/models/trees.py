@@ -167,7 +167,11 @@ class _RandomForestBase(_TreeBase):
     def _fit_forest(self, xb, S, C, static):
         n_trees = int(static.get("n_estimators", 100))
         base_key = jax.random.PRNGKey(static["_seed"])
-        keys = jax.random.split(base_key, n_trees)
+        # per-tree keys via fold_in(t) — the SAME stream the chunked paths
+        # use, so monolithic and chunked fits of one config are identical
+        keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(
+            jnp.arange(n_trees)
+        )
         return jax.lax.map(lambda k: self._one_tree(xb, S, C, static, k), keys)
 
     # ---- chunked-fit protocol (parallel/trial_map.py chunked path) ----
@@ -238,6 +242,24 @@ class _RandomForestBase(_TreeBase):
             "mse": weighted_mse(y, pred, w_eval),
         }
 
+    # artifact materialization (trial_map.fit_single chunked branch)
+    def fit_chunk(self, X, y, w, hyper, static, chunk_idx, carry, plan):
+        xb = X["xb"] if isinstance(X, dict) else X
+        w = w.astype(jnp.float32)
+        S, _ = self._stat_matrix(y, w, static)
+        g = plan["trees_per_chunk"]
+        base_key = jax.random.PRNGKey(static["_seed"])
+        idx = chunk_idx * g + jnp.arange(g)
+        keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(idx)
+        trees = jax.lax.map(lambda k: self._one_tree(xb, S, w, static, k), keys)
+        return carry, trees
+
+    def assemble_artifact(self, trees, X, hyper, static, data_y, data_w):
+        params = {"trees": trees}
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
     def _forest_leaf_mean(self, params, xq, static):
         trees = params["trees"]
         depth = static["_depth"]
@@ -260,10 +282,7 @@ class RandomForestClassifierKernel(_RandomForestBase):
         w = w.astype(jnp.float32)
         S = jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None]
         trees = self._fit_forest(xb, S, w, static)
-        params = {"trees": trees}
-        if isinstance(X, dict):
-            params["edges"] = X["edges"]
-        return params
+        return self.assemble_artifact(trees, X, hyper, static, y, w)
 
     def predict(self, params, X, static: Dict[str, Any]):
         xq = self._query_bins(params, X, static)
@@ -281,10 +300,7 @@ class RandomForestRegressorKernel(_RandomForestBase):
         w = w.astype(jnp.float32)
         S = (y.astype(jnp.float32) * w)[:, None]
         trees = self._fit_forest(xb, S, w, static)
-        params = {"trees": trees}
-        if isinstance(X, dict):
-            params["edges"] = X["edges"]
-        return params
+        return self.assemble_artifact(trees, X, hyper, static, y, w)
 
     def predict(self, params, X, static: Dict[str, Any]):
         xq = self._query_bins(params, X, static)
@@ -292,6 +308,85 @@ class RandomForestRegressorKernel(_RandomForestBase):
 
 
 class _GradientBoostingBase(_TreeBase):
+    """Boosting stages are sequential, so the chunked-fit state is the
+    raw-score vector F carried across dispatches (chunk_step advances g
+    stages; chunk_eval scores directly from F — no trees needed for the
+    trial-search path). Subclasses provide ``_prior``/``_f0``/``_stage``."""
+
+    def chunked_plan(self, static, n, d, n_classes, n_splits):
+        chunk_macs = float(os.environ.get("CS230_TREE_CHUNK_MACS", 4e13))
+        stages = int(static.get("n_estimators", 100))
+        k_eff = (
+            max(int(n_classes), 2) if self.task == "classification" and n_classes > 2
+            else 1
+        )
+        depth = static["_depth"]
+        # per-class trees carry (grad, hess) stats -> kk = 2; HIGHEST
+        # precision matmuls cost ~3x the bf16 path, folded into the budget
+        macs = (
+            3.0 * float(max(n_splits, 1)) * stages * k_eff * n
+            * (2 ** max(depth - 1, 0)) * 2 * d * static["_n_bins"]
+        )
+        n_chunks = int(np.ceil(macs / chunk_macs))
+        if n_chunks <= 1:
+            return None
+        per_chunk = int(np.ceil(stages / n_chunks))
+        return {"n_chunks": int(np.ceil(stages / per_chunk)),
+                "trees_per_chunk": per_chunk}
+
+    def chunk_init(self, X, y, w, hyper, static):
+        xb = X["xb"] if isinstance(X, dict) else X
+        w = w.astype(jnp.float32)
+        return self._f0(xb.shape[0], self._prior(y, w, static), static)
+
+    def chunk_step(self, X, y, w, hyper, static, chunk_idx, state, plan):
+        # same stage loop as fit_chunk; XLA dead-code-eliminates the
+        # unused stacked trees under jit
+        state, _ = self.fit_chunk(X, y, w, hyper, static, chunk_idx, state, plan)
+        return state
+
+    def chunk_eval(self, X, y, w_eval, hyper, static, state):
+        from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+
+        if self.task == "classification":
+            pred = jnp.argmax(state, axis=-1).astype(jnp.int32)
+            return {"score": weighted_accuracy(y, pred, w_eval)}
+        return {
+            "score": weighted_r2(y, state, w_eval),
+            "mse": weighted_mse(y, state, w_eval),
+        }
+
+    # artifact materialization (trial_map.fit_single chunked branch)
+    def fit_chunk(self, X, y, w, hyper, static, chunk_idx, carry, plan):
+        xb = X["xb"] if isinstance(X, dict) else X
+        w = w.astype(jnp.float32)
+        n_stages = int(static.get("n_estimators", 100))
+        g = plan["trees_per_chunk"]
+        base_key = jax.random.PRNGKey(static["_seed"])
+
+        def one(F, i):
+            t = chunk_idx * g + i
+            key = jax.random.fold_in(base_key, t)
+            F_new, trees = self._stage(xb, y, w, hyper, static, F, key)
+            live = t < n_stages
+            F_out = jax.tree_util.tree_map(
+                lambda a, b: jnp.where(live, a, b), F_new, F
+            )
+            return F_out, trees
+
+        carry, trees = jax.lax.scan(one, carry, jnp.arange(g))
+        return carry, trees
+
+    def assemble_artifact(self, trees, X, hyper, static, data_y, data_w):
+        params = {
+            "trees": trees,
+            "prior": self._prior(data_y, data_w.astype(jnp.float32), static),
+            "lr": jnp.asarray(hyper["learning_rate"], jnp.float32),
+        }
+        if isinstance(X, dict):
+            params["edges"] = X["edges"]
+        return params
+
     hyper_defaults = {"learning_rate": 0.1, "subsample": 1.0}
     static_defaults = {
         "n_estimators": 100,
@@ -320,71 +415,83 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
     name = "GradientBoostingClassifier"
     task = "classification"
 
-    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
-        xb = X["xb"] if isinstance(X, dict) else X
+    def _prior(self, y, w, static):
+        c = max(int(static["_n_classes"]), 2)
+        Y = jax.nn.one_hot(y, c, dtype=jnp.float32)
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        return jnp.log(jnp.maximum(jnp.sum(Y * w[:, None], 0) / wsum, 1e-12))
+
+    def _f0(self, n, prior, static):
+        c = max(int(static["_n_classes"]), 2)
+        if c > 2:
+            return jnp.broadcast_to(prior, (n, c))
+        return jnp.stack(
+            [jnp.zeros(n), jnp.broadcast_to(prior[1] - prior[0], (n,))], axis=1
+        )
+
+    def _stage(self, xb, y, w, hyper, static, F, key):
+        """One boosting stage: (F, key) -> (F', per-class trees)."""
         c = max(int(static["_n_classes"]), 2)
         n = xb.shape[0]
-        w = w.astype(jnp.float32)
         depth, n_bins = static["_depth"], static["_n_bins"]
-        n_stages = int(static.get("n_estimators", 100))
         lr = jnp.asarray(hyper["learning_rate"], jnp.float32)
         subsample = jnp.asarray(hyper["subsample"], jnp.float32)
         Y = jax.nn.one_hot(y, c, dtype=jnp.float32)
-        wsum = jnp.maximum(jnp.sum(w), 1e-12)
-        prior = jnp.log(jnp.maximum(jnp.sum(Y * w[:, None], 0) / wsum, 1e-12))
         leaf_scale = (c - 1) / c if c > 2 else 1.0
+        sub_key, feat_key = jax.random.split(key)
+        mask = (jax.random.uniform(sub_key, (n,)) < subsample).astype(jnp.float32) * w
+        P = jax.nn.softmax(F, axis=-1) if c > 2 else jax.nn.sigmoid(F)
+        if c > 2:
+            G = (Y - P) * mask[:, None]
+            H = P * (1.0 - P) * mask[:, None]
+        else:
+            G = (Y[:, 1:] - P[:, 1:]) * mask[:, None]
+            H = (P[:, 1:] * (1.0 - P[:, 1:])) * mask[:, None]
+
+        def per_class(g, h, k2):
+            return build_tree(
+                xb,
+                g[:, None],
+                jnp.maximum(h, 1e-12),
+                depth=depth,
+                n_bins=n_bins,
+                min_samples_leaf=static["_msl"],
+                max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
+                key=k2,
+            )
+
+        kdim = G.shape[1]
+        keys = jax.random.split(feat_key, kdim)
+        trees = jax.vmap(per_class, in_axes=(1, 1, 0))(G, H, keys)
+
+        def upd(tree):
+            return predict_tree(xb, tree, depth)[:, 0]
+
+        delta = jax.vmap(upd)(trees).T  # [n, kdim]
+        if c > 2:
+            F = F + lr * leaf_scale * delta
+        else:
+            F = F.at[:, 1].add(lr * delta[:, 0])
+        return F, trees
+
+    def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        xb = X["xb"] if isinstance(X, dict) else X
+        n = xb.shape[0]
+        w = w.astype(jnp.float32)
+        n_stages = int(static.get("n_estimators", 100))
         base_key = jax.random.PRNGKey(static["_seed"])
 
-        def stage(carry, key):
-            F = carry
-            sub_key, feat_key = jax.random.split(key)
-            mask = (
-                jax.random.uniform(sub_key, (n,)) < subsample
-            ).astype(jnp.float32) * w
-            P = jax.nn.softmax(F, axis=-1) if c > 2 else jax.nn.sigmoid(F)
-            if c > 2:
-                G = (Y - P) * mask[:, None]
-                H = P * (1.0 - P) * mask[:, None]
-            else:
-                G = (Y[:, 1:] - P[:, 1:]) * mask[:, None]
-                H = (P[:, 1:] * (1.0 - P[:, 1:])) * mask[:, None]
+        def stage(F, t):
+            # fold_in(t) stage keys — identical stream to the chunked paths
+            return self._stage(
+                xb, y, w, hyper, static, F, jax.random.fold_in(base_key, t)
+            )
 
-            def per_class(g, h, k2):
-                tree = build_tree(
-                    xb,
-                    g[:, None],
-                    jnp.maximum(h, 1e-12),
-                    depth=depth,
-                    n_bins=n_bins,
-                    min_samples_leaf=static["_msl"],
-                    max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
-                    key=k2,
-                )
-                return tree
-
-            kdim = G.shape[1]
-            keys = jax.random.split(feat_key, kdim)
-            trees = jax.vmap(per_class, in_axes=(1, 1, 0))(G, H, keys)
-
-            def upd(tree):
-                return predict_tree(xb, tree, depth)[:, 0]
-
-            delta = jax.vmap(upd)(trees).T  # [n, kdim]
-            if c > 2:
-                F = F + lr * leaf_scale * delta
-            else:
-                F = F.at[:, 1].add(lr * delta[:, 0])
-            return F, trees
-
-        F0 = jnp.broadcast_to(prior, (n, c)) if c > 2 else jnp.stack(
-            [jnp.zeros(n), jnp.broadcast_to(prior[1] - prior[0], (n,))], axis=1
+        _, trees = jax.lax.scan(
+            stage, self._f0(n, self._prior(y, w, static), static),
+            jnp.arange(n_stages),
         )
-        keys = jax.random.split(base_key, n_stages)
-        _, trees = jax.lax.scan(stage, F0, keys)
-        params = {"trees": trees, "prior": prior, "lr": lr}
-        if isinstance(X, dict):
-            params["edges"] = X["edges"]
-        return params
+        return self.assemble_artifact(trees, X, hyper, static, y, w)
 
     def predict(self, params, X, static: Dict[str, Any]):
         c = max(int(static["_n_classes"]), 2)
@@ -419,45 +526,52 @@ class GradientBoostingRegressorKernel(_GradientBoostingBase):
     name = "GradientBoostingRegressor"
     task = "regression"
 
+    def _prior(self, y, w, static):
+        wsum = jnp.maximum(jnp.sum(w), 1e-12)
+        return jnp.sum(y.astype(jnp.float32) * w) / wsum
+
+    def _f0(self, n, prior, static):
+        return jnp.full((n,), prior)
+
+    def _stage(self, xb, y, w, hyper, static, F, key):
+        n = xb.shape[0]
+        depth, n_bins = static["_depth"], static["_n_bins"]
+        lr = jnp.asarray(hyper["learning_rate"], jnp.float32)
+        subsample = jnp.asarray(hyper["subsample"], jnp.float32)
+        sub_key, feat_key = jax.random.split(key)
+        mask = (jax.random.uniform(sub_key, (n,)) < subsample).astype(jnp.float32) * w
+        g = (y.astype(jnp.float32) - F) * mask
+        tree = build_tree(
+            xb,
+            g[:, None],
+            mask,
+            depth=depth,
+            n_bins=n_bins,
+            min_samples_leaf=static["_msl"],
+            max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
+            key=feat_key,
+        )
+        F = F + lr * predict_tree(xb, tree, depth)[:, 0]
+        return F, tree
+
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
         xb = X["xb"] if isinstance(X, dict) else X
         n = xb.shape[0]
-        y = y.astype(jnp.float32)
         w = w.astype(jnp.float32)
-        depth, n_bins = static["_depth"], static["_n_bins"]
         n_stages = int(static.get("n_estimators", 100))
-        lr = jnp.asarray(hyper["learning_rate"], jnp.float32)
-        subsample = jnp.asarray(hyper["subsample"], jnp.float32)
-        wsum = jnp.maximum(jnp.sum(w), 1e-12)
-        prior = jnp.sum(y * w) / wsum
         base_key = jax.random.PRNGKey(static["_seed"])
 
-        def stage(F, key):
-            sub_key, feat_key = jax.random.split(key)
-            mask = (
-                jax.random.uniform(sub_key, (n,)) < subsample
-            ).astype(jnp.float32) * w
-            g = (y - F) * mask
-            tree = build_tree(
-                xb,
-                g[:, None],
-                mask,
-                depth=depth,
-                n_bins=n_bins,
-                min_samples_leaf=static["_msl"],
-                max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
-                key=feat_key,
+        def stage(F, t):
+            # fold_in(t) stage keys — identical stream to the chunked paths
+            return self._stage(
+                xb, y, w, hyper, static, F, jax.random.fold_in(base_key, t)
             )
-            F = F + lr * predict_tree(xb, tree, depth)[:, 0]
-            return F, tree
 
-        F0 = jnp.full((n,), prior)
-        keys = jax.random.split(base_key, n_stages)
-        _, trees = jax.lax.scan(stage, F0, keys)
-        params = {"trees": trees, "prior": prior, "lr": lr}
-        if isinstance(X, dict):
-            params["edges"] = X["edges"]
-        return params
+        _, trees = jax.lax.scan(
+            stage, self._f0(n, self._prior(y, w, static), static),
+            jnp.arange(n_stages),
+        )
+        return self.assemble_artifact(trees, X, hyper, static, y, w)
 
     def predict(self, params, X, static: Dict[str, Any]):
         depth = static["_depth"]

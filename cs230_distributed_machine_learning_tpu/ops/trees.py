@@ -10,8 +10,10 @@ formulation (LightGBM-style) is the TPU shape of the same computation:
   (int codes), so a split candidate is (feature, bin);
 - trees grow **level-wise** over a complete binary tree of static depth:
   at level l every sample sits at one of 2^l nodes, and all node×feature×bin
-  histograms are built with one ``segment_sum`` (a gather/scatter XLA fuses
-  well) followed by a cumulative sum over bins;
+  histograms are built as one-hot matmul contractions on the MXU
+  (``_level_histogram``; TPU scatters serialize, matmuls don't), with the
+  right-child histograms derived by subtraction from the parent level,
+  followed by a cumulative sum over bins;
 - the split score is the unified proxy ``sum_k S_k^2 / C`` (left+right),
   which instantiates to variance gain (regression, S=sum y, C=count), gini
   gain (classification, S=class counts), and the Newton gain

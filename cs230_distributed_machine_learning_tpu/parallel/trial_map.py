@@ -252,6 +252,38 @@ def fit_single(
         data.X.shape,
         data.n_classes,
     )
+
+    # ensemble kernels on large data: materialize the winner's trees across
+    # bounded-time dispatches too (same rationale as the chunked trial path)
+    chunk_plan = None
+    if hasattr(kernel, "chunked_plan") and hasattr(kernel, "fit_chunk"):
+        chunk_plan = kernel.chunked_plan(static, n, d, data.n_classes, 1)
+    if chunk_plan:
+        n_chunks = int(chunk_plan["n_chunks"])
+        ck = fit_key + ("chunked", n_chunks, chunk_plan["trees_per_chunk"])
+        if ck not in _compiled_cache:
+            _compiled_cache[ck] = (
+                jax.jit(lambda X, y, w, h: kernel.chunk_init(X, y, w, h, static)),
+                jax.jit(
+                    lambda X, y, w, h, ci, carry: kernel.fit_chunk(
+                        X, y, w, h, static, ci, carry, chunk_plan
+                    )
+                ),
+            )
+        f_init, f_chunk = _compiled_cache[ck]
+        carry = f_init(X, y, w, hyper_arg)
+        parts = []
+        for ci in range(n_chunks):
+            carry, part = f_chunk(X, y, w, hyper_arg, jnp.int32(ci), carry)
+            parts.append(part)  # device arrays: dispatches pipeline
+        n_units = int(static.get("n_estimators", 100))
+        parts = [jax.tree_util.tree_map(np.asarray, p) for p in parts]
+        trees = jax.tree_util.tree_map(
+            lambda *xs: np.concatenate(xs, axis=0)[:n_units], *parts
+        )
+        fitted = kernel.assemble_artifact(trees, X, hyper_arg, static, y, w)
+        return jax.tree_util.tree_map(np.asarray, fitted), static
+
     if fit_key not in _compiled_cache:
         _compiled_cache[fit_key] = jax.jit(
             lambda X, y, w, h: kernel.fit(X, y, w, h, static)

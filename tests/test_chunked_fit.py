@@ -25,6 +25,8 @@ def _toy(task="classification", n=400, d=8, seed=0):
 @pytest.mark.parametrize("model,task", [
     ("RandomForestClassifier", "classification"),
     ("RandomForestRegressor", "regression"),
+    ("GradientBoostingClassifier", "classification"),
+    ("GradientBoostingRegressor", "regression"),
 ])
 def test_chunked_matches_quality(model, task, monkeypatch):
     """Forcing many chunks must not change result quality materially —
@@ -81,3 +83,38 @@ def test_chunked_grid_multiple_trials(monkeypatch):
     assert len(run.trial_metrics) == 2
     for m in run.trial_metrics:
         assert 0.5 < m["mean_cv_score"] <= 1.0
+
+@pytest.mark.parametrize("model,task", [
+    ("RandomForestClassifier", "classification"),
+    ("GradientBoostingRegressor", "regression"),
+])
+def test_fit_single_chunked_artifact(model, task, monkeypatch):
+    """fit_single through the chunked branch must yield a usable artifact
+    whose predictions score like the monolithic one."""
+    import jax.numpy as jnp
+
+    data = _toy(task)
+    plan = build_split_plan(np.asarray(data.y), task=task, n_folds=3)
+    kernel = get_kernel(model)
+    params = {"n_estimators": 20, "max_depth": 4, "random_state": 0}
+
+    trial_map._compiled_cache.clear()
+    fitted_mono, static = trial_map.fit_single(kernel, data, plan, params)
+
+    monkeypatch.setenv("CS230_TREE_CHUNK_MACS", "1e6")
+    trial_map._compiled_cache.clear()
+    fitted_chunk, static2 = trial_map.fit_single(kernel, data, plan, params)
+
+    # same tree-count artifact, comparable in-sample quality
+    assert fitted_chunk["trees"]["leaf_val"].shape == fitted_mono["trees"]["leaf_val"].shape
+    import jax
+
+    X = jnp.asarray(data.X)
+    pred_c = np.asarray(kernel.predict(
+        jax.tree_util.tree_map(jnp.asarray, fitted_chunk), X, static))
+    y = np.asarray(data.y)
+    if task == "classification":
+        assert (pred_c == y).mean() > 0.85
+    else:
+        ss = 1 - ((pred_c - y) ** 2).sum() / ((y - y.mean()) ** 2).sum()
+        assert ss > 0.7
