@@ -1,0 +1,120 @@
+"""Compose-equivalent harness: real OS processes over localhost HTTP.
+
+The reference's cluster simulator was docker-compose on one machine
+(SURVEY.md §4: 4 worker containers + Kafka/Redis stand in for the EC2
+fleet). The equivalent here: a coordinator-server process and a worker-agent
+process, spawned as separate interpreters, exercised by this test process as
+the client over the same REST surface a remote user gets.
+"""
+
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVER_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.server import serve
+import sys
+serve(Coordinator(cluster=ClusterRuntime()), host="127.0.0.1", port=int(sys.argv[1]))
+"""
+
+AGENT_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from cs230_distributed_machine_learning_tpu.runtime.agent import WorkerAgent
+agent = WorkerAgent(sys.argv[1], poll_timeout_s=0.5, register_backoff_s=0.5)
+agent.run_forever()
+"""
+
+
+def _wait_http(url, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return True
+        except Exception:  # noqa: BLE001
+            time.sleep(0.3)
+    return False
+
+
+@pytest.fixture()
+def fleet(tmp_path):
+    """Server + one agent as real subprocesses sharing a storage root."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    procs = []
+    try:
+        server = subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT, str(port)],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(server)
+        url = f"http://127.0.0.1:{port}"
+        assert _wait_http(f"{url}/health"), "server did not come up"
+        agent = subprocess.Popen(
+            [sys.executable, "-c", AGENT_SCRIPT, url],
+            env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        procs.append(agent)
+        yield url
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_multiprocess_fleet_end_to_end(fleet):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+    url = fleet
+    # wait until the agent registered
+    deadline = time.time() + 90
+    import json
+
+    while time.time() < deadline:
+        with urllib.request.urlopen(f"{url}/workers", timeout=5) as r:
+            if json.load(r):
+                break
+        time.sleep(0.5)
+    else:
+        pytest.fail("agent never registered")
+
+    m = MLTaskManager(url=url)
+    status = m.train(
+        GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.1, 1.0]}, cv=3),
+        "iris",
+        show_progress=False,
+        timeout=240,
+    )
+    assert status["job_status"] == "completed"
+    result = status["job_result"]
+    assert len(result["results"]) == 2 and not result.get("failed")
+    best = result["best_result"]
+    assert best["mean_cv_score"] > 0.8
