@@ -321,10 +321,13 @@ class _GradientBoostingBase(_TreeBase):
             else 1
         )
         depth = static["_depth"]
-        # per-class trees carry (grad, hess) stats -> kk = 2; HIGHEST
-        # precision matmuls cost ~3x the bf16 path, folded into the budget
+        # per-class trees carry (grad, hess) stats -> kk = 2. Measured
+        # effective throughput is ~7x below the RF path (HIGHEST-precision
+        # matmuls + tiny node*kk contraction dims at the default depth 3
+        # underfill the MXU), so weight the nominal MACs by 10x to keep each
+        # dispatch's wall time in the same envelope as RF chunks
         macs = (
-            3.0 * float(max(n_splits, 1)) * stages * k_eff * n
+            10.0 * float(max(n_splits, 1)) * stages * k_eff * n
             * (2 ** max(depth - 1, 0)) * 2 * d * static["_n_bins"]
         )
         n_chunks = int(np.ceil(macs / chunk_macs))

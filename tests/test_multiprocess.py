@@ -37,9 +37,11 @@ agent.run_forever()
 """
 
 
-def _wait_http(url, timeout=60):
+def _wait_http(url, timeout=60, proc=None):
     deadline = time.time() + timeout
     while time.time() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False  # process died — fail fast, caller prints stderr
         try:
             with urllib.request.urlopen(url, timeout=2):
                 return True
@@ -61,22 +63,32 @@ def fleet(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.pop("JAX_PLATFORMS", None)
     procs = []
+    server_log = open(tmp_path / "server.log", "w+")
+    agent_log = open(tmp_path / "agent.log", "w+")
+
+    def _tail(f):
+        f.flush()
+        f.seek(0)
+        return f.read()[-2000:]
+
     try:
         server = subprocess.Popen(
             [sys.executable, "-c", SERVER_SCRIPT, str(port)],
             env=env, cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            stdout=server_log, stderr=subprocess.STDOUT,
         )
         procs.append(server)
         url = f"http://127.0.0.1:{port}"
-        assert _wait_http(f"{url}/health"), "server did not come up"
+        assert _wait_http(f"{url}/health", proc=server), (
+            f"server did not come up:\n{_tail(server_log)}"
+        )
         agent = subprocess.Popen(
             [sys.executable, "-c", AGENT_SCRIPT, url],
             env=env, cwd=REPO,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            stdout=agent_log, stderr=subprocess.STDOUT,
         )
         procs.append(agent)
-        yield url
+        yield url, server, agent, _tail, server_log, agent_log
     finally:
         for p in procs:
             p.terminate()
@@ -85,6 +97,8 @@ def fleet(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+        server_log.close()
+        agent_log.close()
 
 
 def test_multiprocess_fleet_end_to_end(fleet):
@@ -93,18 +107,23 @@ def test_multiprocess_fleet_end_to_end(fleet):
 
     from cs230_distributed_machine_learning_tpu import MLTaskManager
 
-    url = fleet
+    url, server, agent, tail, server_log, agent_log = fleet
     # wait until the agent registered
     deadline = time.time() + 90
     import json
 
     while time.time() < deadline:
-        with urllib.request.urlopen(f"{url}/workers", timeout=5) as r:
-            if json.load(r):
-                break
+        if agent.poll() is not None:
+            pytest.fail(f"agent died:\n{tail(agent_log)}")
+        try:
+            with urllib.request.urlopen(f"{url}/workers", timeout=5) as r:
+                if json.load(r):
+                    break
+        except Exception:  # noqa: BLE001 — transient during startup: retry
+            pass
         time.sleep(0.5)
     else:
-        pytest.fail("agent never registered")
+        pytest.fail(f"agent never registered:\n{tail(agent_log)}")
 
     m = MLTaskManager(url=url)
     status = m.train(
