@@ -239,6 +239,11 @@ class LogisticRegressionKernel(ModelKernel):
             W0 = jnp.zeros((n_wb, dpp, NB), jnp.float32)
             done0 = jnp.zeros((n_wb, Bblk), bool)
 
+            # fixed-length scan (length already capped to the bucket's max
+            # max_iter by bucket_static's _iters). A while_loop with an
+            # all-converged early exit measures ~20% SLOWER here: the
+            # per-step cond reduce acts as a barrier, and slow-converging
+            # trials run to max_iter anyway.
             def body(carry, t):
                 W, Wp, done = carry
                 mom = t / (t + 3.0)

@@ -61,6 +61,7 @@ def fleet(tmp_path):
     env = dict(os.environ)
     env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"  # child prints must reach the log files
     env.pop("JAX_PLATFORMS", None)
     procs = []
     server_log = open(tmp_path / "server.log", "w+")
@@ -113,6 +114,8 @@ def test_multiprocess_fleet_end_to_end(fleet):
     import json
 
     while time.time() < deadline:
+        if server.poll() is not None:
+            pytest.fail(f"server died:\n{tail(server_log)}")
         if agent.poll() is not None:
             pytest.fail(f"agent died:\n{tail(agent_log)}")
         try:
