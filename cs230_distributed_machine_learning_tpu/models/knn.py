@@ -21,17 +21,20 @@ argmax-over-counts reproduces.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .base import ModelKernel
 
 _QUERY_BLOCK = 1024
+_TRAIN_TILE = 16384
 # above this many training rows on TPU, use the fused Pallas top-k kernel
-# (streams train tiles through VMEM; the XLA path would materialize a
-# [block, n] distance matrix per query block)
+# (streams train tiles through VMEM; the XLA path streams the same tiles
+# but pays a per-tile sort-based top-k merge in HBM)
 _PALLAS_MIN_N = 150_000
 
 
@@ -71,23 +74,54 @@ class _KNNBase(ModelKernel):
             from ..ops.pallas_knn import knn_topk
 
             return knn_topk(Q, Xt, w, k)
-        sq_t = jnp.sum(Xt * Xt, axis=1)  # [n]
         big = jnp.float32(3.4e38)
+        n, d = Xt.shape
+
+        # train side padded to tile multiples; padded rows carry w=0 so
+        # they are masked to +inf distance
+        T = min(_TRAIN_TILE, max(n, 1))
+        n_tp = ((n + T - 1) // T) * T
+        Xtp = jnp.pad(Xt, ((0, n_tp - n), (0, 0)))
+        wp = jnp.pad(w, (0, n_tp - n))
+        sq_tp = jnp.sum(Xtp * Xtp, axis=1)
 
         nq = Q.shape[0]
         pad = (-nq) % _QUERY_BLOCK
         Qp = jnp.pad(Q, ((0, pad), (0, 0)))
-        blocks = Qp.reshape(-1, _QUERY_BLOCK, Q.shape[1])
+        blocks = Qp.reshape(-1, _QUERY_BLOCK, d)
 
         def one_block(qb):
-            d2 = (
-                jnp.sum(qb * qb, axis=1, keepdims=True)
-                + sq_t[None, :]
-                - 2.0 * (qb @ Xt.T)
+            sq_q = jnp.sum(qb * qb, axis=1, keepdims=True)
+
+            # stream train tiles, merging into a running top-k: peak memory
+            # is [block, tile + k], never [block, n] (an n x n distance/sort
+            # workspace faults the device at Covertype scale). Tie-break to
+            # the smallest train index (sklearn order): earlier tiles sit
+            # first in the merge concat and lax.top_k prefers lower
+            # positions on ties.
+            def tile_step(carry, tstart):
+                best_d, best_i = carry
+                xt = jax.lax.dynamic_slice(Xtp, (tstart, 0), (T, d))
+                st = jax.lax.dynamic_slice(sq_tp, (tstart,), (T,))
+                wt = jax.lax.dynamic_slice(wp, (tstart,), (T,))
+                d2 = sq_q + st[None, :] - 2.0 * (qb @ xt.T)
+                d2 = jnp.where(wt[None, :] > 0, jnp.maximum(d2, 0.0), big)
+                cat_d = jnp.concatenate([best_d, d2], axis=1)
+                idx_tile = jnp.broadcast_to(
+                    tstart + jnp.arange(T, dtype=jnp.int32)[None, :], d2.shape
+                )
+                cat_i = jnp.concatenate([best_i, idx_tile], axis=1)
+                neg, sel = jax.lax.top_k(-cat_d, k)
+                return (-neg, jnp.take_along_axis(cat_i, sel, axis=1)), None
+
+            init = (
+                jnp.full((qb.shape[0], k), big),
+                jnp.zeros((qb.shape[0], k), jnp.int32),
             )
-            d2 = jnp.where(w[None, :] > 0, jnp.maximum(d2, 0.0), big)
-            neg, idx = jax.lax.top_k(-d2, k)
-            return -neg, idx
+            (best_d, best_i), _ = jax.lax.scan(
+                tile_step, init, jnp.arange(0, n_tp, T, dtype=jnp.int32)
+            )
+            return best_d, best_i
 
         d2s, idxs = jax.lax.map(one_block, blocks)
         return (
@@ -107,7 +141,60 @@ class _KNNBase(ModelKernel):
         return jnp.ones_like(d2)
 
     def memory_estimate_mb(self, n, d, static):
-        return max(1.0, 4.0 * (n * d + _QUERY_BLOCK * n) / 1e6)
+        # tiled top-k workspace: [QUERY_BLOCK, TRAIN_TILE] per split plus
+        # the shared [n, d] dataset (the [block, n] full distance matrix no
+        # longer exists)
+        return max(1.0, 4.0 * (n * d + 3 * _QUERY_BLOCK * _TRAIN_TILE) / 1e6)
+
+    # ---- chunked-fit protocol (parallel/trial_map.py chunked path) ----
+    # KNN "training" is free; the cost is the n_query x n_train distance
+    # sweep at scoring time. Chunks split the QUERY rows: each dispatch
+    # predicts one row range into an accumulating prediction vector, so the
+    # per-dispatch device time stays bounded at any dataset size.
+
+    def chunked_plan(self, static, n, d, n_classes, n_splits):
+        # measured effective throughput is ~2.5e10 MACs/s — the per-tile
+        # top-k merge (sort), not the distance matmul, dominates — so the
+        # per-dispatch budget is far below the matmul-bound kernels'
+        chunk_macs = float(os.environ.get("CS230_KNN_CHUNK_MACS", 2.5e11))
+        macs = float(max(n_splits, 1)) * n * n * max(d, 1)
+        n_chunks = int(np.ceil(macs / chunk_macs))
+        if n_chunks <= 1:
+            return None
+        q = int(np.ceil(n / n_chunks))
+        q = max(_QUERY_BLOCK, ((q + _QUERY_BLOCK - 1) // _QUERY_BLOCK) * _QUERY_BLOCK)
+        n_chunks = int(np.ceil(n / q))
+        if n_chunks <= 1:  # rounding collapsed it: monolithic is cheaper
+            return None
+        return {"n_chunks": n_chunks, "rows_per_chunk": q}
+
+    def _chunk_state_dtype(self):
+        return jnp.int32 if self.task == "classification" else jnp.float32
+
+    def chunk_init(self, X, y, w, hyper, static):
+        return jnp.zeros((X.shape[0],), self._chunk_state_dtype())
+
+    def chunk_step(self, X, y, w, hyper, static, chunk_idx, state, plan):
+        Xa = X.astype(jnp.float32)
+        q = plan["rows_per_chunk"]
+        n = Xa.shape[0]
+        # dynamic_slice clamps the start, so the final (ragged) chunk
+        # re-predicts a few overlapping rows with identical values
+        start = jnp.minimum(chunk_idx * q, max(n - q, 0))
+        Q = jax.lax.dynamic_slice(Xa, (start, 0), (min(q, n), Xa.shape[1]))
+        params = self.fit(Xa, y, w, hyper, static)
+        preds = self.predict(params, Q, static).astype(self._chunk_state_dtype())
+        return jax.lax.dynamic_update_slice(state, preds, (start,))
+
+    def chunk_eval(self, X, y, w_eval, hyper, static, state):
+        from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+
+        if self.task == "classification":
+            return {"score": weighted_accuracy(y, state, w_eval)}
+        return {
+            "score": weighted_r2(y, state, w_eval),
+            "mse": weighted_mse(y, state, w_eval),
+        }
 
 
 class KNNClassifierKernel(_KNNBase):

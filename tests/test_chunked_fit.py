@@ -67,6 +67,37 @@ def test_chunked_plan_thresholds():
     assert kernel.chunked_plan(static, 150, 4, 3, 6) is None
 
 
+@pytest.mark.parametrize("model,task", [
+    ("KNeighborsClassifier", "classification"),
+    ("KNeighborsRegressor", "regression"),
+])
+def test_knn_chunked_matches_monolithic(model, task, monkeypatch):
+    """Query-row chunking must produce the SAME predictions as one dispatch
+    (KNN is deterministic — exact equality expected)."""
+    data = _toy(task, n=3500)  # > 3 query blocks so >1 chunk is possible
+    plan = build_split_plan(np.asarray(data.y), task=task, n_folds=3)
+    kernel = get_kernel(model)
+    params = [{"n_neighbors": 5}]
+
+    trial_map._compiled_cache.clear()
+    mono = trial_map.run_trials(kernel, data, plan, params)
+    assert mono.n_dispatches == 1
+
+    monkeypatch.setenv("CS230_KNN_CHUNK_MACS", "1e5")
+    static = kernel.resolve_static({"n_neighbors": 5, "weights": "uniform", "p": 2},
+                                   3500, data.X.shape[1], data.n_classes)
+    assert kernel.chunked_plan(static, 3500, data.X.shape[1], data.n_classes, 4)["n_chunks"] > 1
+    trial_map._compiled_cache.clear()
+    chunked = trial_map.run_trials(kernel, data, plan, params)
+    assert chunked.n_dispatches > 3  # init + >=2 steps + eval
+
+    np.testing.assert_allclose(
+        mono.trial_metrics[0]["mean_cv_score"],
+        chunked.trial_metrics[0]["mean_cv_score"],
+        rtol=1e-6,
+    )
+
+
 def test_chunked_grid_multiple_trials(monkeypatch):
     """A small grid through the chunked path: per-trial results keep
     submission order and rank sensibly."""
