@@ -9,7 +9,9 @@ Capability target: the four ensemble rows of the reference whitelist
   compile bucket; learning_rate and subsample are traced.
 - sklearn's ``max_depth=None`` (grow to purity) is capped at a static depth
   (10) — a documented approximation; unsplittable nodes pass through, so a
-  shallower-than-cap tree is representable exactly.
+  shallower-than-cap tree is representable exactly. An EXPLICIT max_depth
+  may go to 14 on the ensemble kernels (their chunked fits bound dispatch
+  time; each level doubles histogram work).
 - RF bootstrap is the exact multinomial resample (n categorical draws from
   the weight-masked rows -> per-row counts), per-node feature subsets follow
   max_features ("sqrt"/"log2"/int/float). Forest prediction averages leaf
@@ -39,7 +41,12 @@ import numpy as np
 from ..ops.trees import bin_data, build_tree, predict_tree, quantile_bins
 from .base import ModelKernel
 
+# heuristic (max_depth=None) cap; an EXPLICIT max_depth may go deeper (to
+# _DEPTH_HARD_CAP) — each level doubles histogram work, but the chunked-fit
+# protocol keeps individual dispatches bounded, so deep requests are a cost
+# choice, not a stability risk
 _DEPTH_CAP = 10
+_DEPTH_HARD_CAP = 14
 
 
 def _resolve_max_features(spec, d: int, default) -> int:
@@ -72,7 +79,11 @@ class _TreeBase(ModelKernel):
             # below, not by shrinking the tree.)
             depth = min(_DEPTH_CAP, max(3, int(np.ceil(np.log2(max(n, 8)))) - 2))
         else:
-            depth = min(int(depth), _DEPTH_CAP)
+            # deep explicit requests are only safe for kernels whose fits
+            # chunk across dispatches; plain DecisionTree (no chunked
+            # protocol) keeps the uniform cap
+            hard = _DEPTH_HARD_CAP if hasattr(self, "chunked_plan") else _DEPTH_CAP
+            depth = min(int(depth), hard)
         mf = _resolve_max_features(static.get("max_features"), d, self._mf_default)
         msl = static.get("min_samples_leaf", 1)
         if isinstance(msl, float) and msl < 1:
