@@ -135,7 +135,9 @@ class MLTaskManager:
             try:
                 from tqdm import tqdm
 
-                bar = tqdm(total=100, desc="job", unit="%")
+                # disable=None: auto-off when stderr is not a tty (piped
+                # logs otherwise get one bar line per poll tick)
+                bar = tqdm(total=100, desc="job", unit="%", disable=None)
             except ImportError:
                 bar = None
         deadline = time.time() + timeout

@@ -42,9 +42,11 @@ from ..ops.trees import bin_data, build_tree, predict_tree, quantile_bins
 from .base import ModelKernel
 
 # heuristic (max_depth=None) cap; an EXPLICIT max_depth may go deeper (to
-# _DEPTH_HARD_CAP) — each level doubles histogram work, but the chunked-fit
-# protocol keeps individual dispatches bounded, so deep requests are a cost
-# choice, not a stability risk
+# _DEPTH_HARD_CAP) on the ensemble kernels — each level doubles histogram
+# work. On a single device the chunked-fit protocol bounds each dispatch's
+# time; on a multi-chip mesh the fit runs monolithic (no per-RPC deadline
+# applies there) and the depth-aware memory estimate throttles
+# trials-per-dispatch either way.
 _DEPTH_CAP = 10
 _DEPTH_HARD_CAP = 14
 
@@ -96,6 +98,17 @@ class _TreeBase(ModelKernel):
             "_msl": float(msl),
             "_seed": int(static.get("random_state") or 0),
         }
+
+    def memory_estimate_mb(self, n: int, d: int, static: Dict[str, Any]) -> float:
+        """Depth-aware: the dominant working set is the deepest level's
+        histogram [2^(depth-1) nodes, d, bins, k+1] (x3 for H/H_prev/stack
+        buffers) plus the binned dataset — 16x growth from depth 10 to 14
+        must throttle trials-per-dispatch accordingly."""
+        depth = int(static.get("_depth", 8))
+        n_bins = int(static.get("_n_bins", 128))
+        kk = max(int(static.get("_n_classes", 2)), 2) + 1
+        hist = 3.0 * (2 ** max(depth - 1, 0)) * d * n_bins * kk * 4
+        return max(1.0, (hist + 4.0 * n * d * 2) / 1e6)
 
     # trial-engine hook: bin once per bucket, share across trials/splits
     def prepare_data(self, X: np.ndarray, static: Dict[str, Any]):
