@@ -91,17 +91,25 @@ class MLTaskManager:
     def train(
         self,
         estimator: Any,
-        dataset_id: str,
+        dataset_id: Optional[str] = None,
         train_params: Optional[Dict[str, Any]] = None,
         wait_for_completion: bool = True,
         timeout: Optional[float] = None,
         show_progress: bool = True,
+        *,
+        dataset_name: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Submit a training / hyperparameter-search job.
 
         train_params: {test_size=0.2, random_state=42, cv=5} — the plain-
         estimator default test_size matches the reference (core.py:160-163).
+        ``dataset_name=`` is accepted as an alias for ``dataset_id`` — the
+        reference README's examples use that keyword (README.md:70-76).
         """
+        if dataset_id is None:
+            dataset_id = dataset_name
+        if dataset_id is None:
+            raise TypeError("train() requires a dataset id (dataset_id= or dataset_name=)")
         model_details = extract_model_details(estimator)
         train_params = dict(train_params or {})
         train_params.setdefault("test_size", get_config().execution.default_test_size)
