@@ -106,7 +106,12 @@ class MLTaskManager:
         ``dataset_name=`` is accepted as an alias for ``dataset_id`` — the
         reference README's examples use that keyword (README.md:70-76).
         """
-        if dataset_id is None:
+        if dataset_name is not None:
+            if dataset_id is not None and dataset_id != dataset_name:
+                raise TypeError(
+                    f"conflicting dataset_id={dataset_id!r} and "
+                    f"dataset_name={dataset_name!r} — pass one"
+                )
             dataset_id = dataset_name
         if dataset_id is None:
             raise TypeError("train() requires a dataset id (dataset_id= or dataset_name=)")

@@ -55,6 +55,7 @@ class GaussianNBKernel(ModelKernel):
 
 
 class _DecisionTreeBase(_TreeBase):
+    _supports_deep = True  # sklearn default max_depth=None grows to purity
     static_defaults = {
         "max_depth": None,
         "min_samples_leaf": 1,
@@ -73,15 +74,10 @@ class _DecisionTreeBase(_TreeBase):
     _mf_default = 1.0
 
     def _fit_tree(self, xb, S, C, static):
-        return build_tree(
-            xb,
-            S,
-            C,
-            depth=static["_depth"],
-            n_bins=static["_n_bins"],
-            min_samples_leaf=static["_msl"],
-            max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
-            key=jax.random.PRNGKey(static["_seed"]),
+        return self._fit_one_tree(
+            xb, S, C, static,
+            jax.random.PRNGKey(static["_seed"]),
+            jax.lax.Precision.HIGHEST,
         )
 
 
@@ -101,7 +97,7 @@ class DecisionTreeClassifierKernel(_DecisionTreeBase):
 
     def predict(self, params, X, static):
         xq = self._query_bins(params, X, static)
-        proba = predict_tree(xq, params["tree"], static["_depth"])
+        proba = self._tree_predict(xq, params["tree"], static)
         return jnp.argmax(proba, axis=-1).astype(jnp.int32)
 
 
@@ -120,7 +116,7 @@ class DecisionTreeRegressorKernel(_DecisionTreeBase):
 
     def predict(self, params, X, static):
         xq = self._query_bins(params, X, static)
-        return predict_tree(xq, params["tree"], static["_depth"])[:, 0]
+        return self._tree_predict(xq, params["tree"], static)[:, 0]
 
 
 from .registry import register_kernel  # noqa: E402  (self-registration on import)

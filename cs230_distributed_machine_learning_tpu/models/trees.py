@@ -38,17 +38,35 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.trees import bin_data, build_tree, predict_tree, quantile_bins
+from ..ops.trees import (
+    bin_data,
+    build_tree,
+    build_tree_deep,
+    predict_tree,
+    predict_tree_deep,
+    quantile_bins,
+)
 from .base import ModelKernel
 
-# heuristic (max_depth=None) cap; an EXPLICIT max_depth may go deeper (to
-# _DEPTH_HARD_CAP) on the ensemble kernels — each level doubles histogram
-# work. On a single device the chunked-fit protocol bounds each dispatch's
-# time; on a multi-chip mesh the fit runs monolithic (no per-RPC deadline
-# applies there) and the depth-aware memory estimate throttles
-# trials-per-dispatch either way.
+# Complete-tree caps (small data / GBT): each level doubles histogram work,
+# so the level-wise complete builder stops at 10 (heuristic) / 14 (explicit
+# on chunked kernels). Above _DEEP_N samples, kernels that grow to purity in
+# sklearn (RF, DecisionTree — the reference's exact-CART fit,
+# aws-prod/worker/worker.py:315) switch to the frontier-compacted deep
+# builder (ops/trees.build_tree_deep): depth to _DEEP_LEVELS with a
+# _DEEP_W-node active frontier per level, the regime where Covertype-class
+# accuracy lives (sklearn RF cv ~0.95 needs depth ~25, not 10).
 _DEPTH_CAP = 10
 _DEPTH_HARD_CAP = 14
+_DEEP_LEVELS = 24
+_DEEP_LEVELS_EXPLICIT = 32
+_DEEP_W = 512
+
+
+def _deep_n_threshold() -> int:
+    """Sample count above which grow-to-purity kernels use the deep builder
+    (env-tunable so CPU tests can exercise the deep path on small data)."""
+    return int(os.environ.get("CS230_TREE_DEEP_N", "4096"))
 
 
 def _resolve_max_features(spec, d: int, default) -> int:
@@ -68,17 +86,36 @@ def _resolve_max_features(spec, d: int, default) -> int:
 class _TreeBase(ModelKernel):
     #: default for max_features resolution (overridden per family)
     _mf_default: Any = 1.0
+    #: sklearn semantics grow this family to purity (RF/DecisionTree) —
+    #: eligible for the deep frontier-compacted builder on large data
+    _supports_deep = False
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         n_bins = int(static.get("n_bins", 128))
         n_bins = min(n_bins, max(8, n))
         depth = static.get("max_depth")
-        if depth is None:
-            # sklearn grows to purity; a tree on n samples can't use more than
-            # ~log2(n) useful levels, so cap there — deeper levels would be
-            # all pass-through nodes, paid for in compile time. (Dispatch
-            # time at large n x depth is bounded by the chunked-fit protocol
-            # below, not by shrinking the tree.)
+        # explicit depths past the complete-builder's cap route to the deep
+        # arena; the cap is kernel-dependent (chunked ensembles honor up to
+        # _DEPTH_HARD_CAP complete levels, plain DT only _DEPTH_CAP), so the
+        # honored depth stays monotonic in the requested depth
+        _complete_cap = (
+            _DEPTH_HARD_CAP if hasattr(self, "chunked_plan") else _DEPTH_CAP
+        )
+        deep = (
+            self._supports_deep
+            and n > _deep_n_threshold()
+            and (depth is None or int(depth) > _complete_cap)
+        )
+        if deep:
+            if depth is None:
+                levels = min(_DEEP_LEVELS, int(np.ceil(np.log2(max(n, 8)))) + 8)
+            else:
+                levels = min(int(depth), _DEEP_LEVELS_EXPLICIT)
+            width = min(_DEEP_W, max(64, 1 << int(np.ceil(np.log2(max(n // 64, 64))))))
+            depth = levels
+        elif depth is None:
+            # small data: the complete-tree builder to ~log2(n) levels is
+            # already near-purity and cheaper to compile than the arena
             depth = min(_DEPTH_CAP, max(3, int(np.ceil(np.log2(max(n, 8)))) - 2))
         else:
             # deep explicit requests are only safe for kernels whose fits
@@ -90,7 +127,7 @@ class _TreeBase(ModelKernel):
         msl = static.get("min_samples_leaf", 1)
         if isinstance(msl, float) and msl < 1:
             msl = max(1, int(msl * n))
-        return {
+        out = {
             **static,
             "_depth": depth,
             "_n_bins": n_bins,
@@ -98,17 +135,48 @@ class _TreeBase(ModelKernel):
             "_msl": float(msl),
             "_seed": int(static.get("random_state") or 0),
         }
+        if deep:
+            out["_deep"] = True
+            out["_levels"] = levels
+            out["_W"] = width
+        return out
 
     def memory_estimate_mb(self, n: int, d: int, static: Dict[str, Any]) -> float:
         """Depth-aware: the dominant working set is the deepest level's
         histogram [2^(depth-1) nodes, d, bins, k+1] (x3 for H/H_prev/stack
         buffers) plus the binned dataset — 16x growth from depth 10 to 14
-        must throttle trials-per-dispatch accordingly."""
-        depth = int(static.get("_depth", 8))
+        must throttle trials-per-dispatch accordingly. Deep (arena) mode is
+        frontier-bounded instead: ~4 histogram-sized buffers of W rows
+        (H, left+right candidates, gathered next-H)."""
         n_bins = int(static.get("_n_bins", 128))
         kk = max(int(static.get("_n_classes", 2)), 2) + 1
-        hist = 3.0 * (2 ** max(depth - 1, 0)) * d * n_bins * kk * 4
+        if static.get("_deep"):
+            W = int(static["_W"])
+            hist = 4.0 * W * d * n_bins * kk * 4
+        else:
+            depth = int(static.get("_depth", 8))
+            hist = 3.0 * (2 ** max(depth - 1, 0)) * d * n_bins * kk * 4
         return max(1.0, (hist + 4.0 * n * d * 2) / 1e6)
+
+    def _fit_one_tree(self, xb, S, C, static, key, precision):
+        """Dispatch to the complete-tree or deep arena builder."""
+        common = dict(
+            n_bins=static["_n_bins"],
+            min_samples_leaf=static["_msl"],
+            max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
+            key=key,
+            precision=precision,
+        )
+        if static.get("_deep"):
+            return build_tree_deep(
+                xb, S, C, levels=static["_levels"], width=static["_W"], **common
+            )
+        return build_tree(xb, S, C, depth=static["_depth"], **common)
+
+    def _tree_predict(self, xq, tree, static):
+        if static.get("_deep"):
+            return predict_tree_deep(xq, tree, static["_levels"])
+        return predict_tree(xq, tree, static["_depth"])
 
     # trial-engine hook: bin once per bucket, share across trials/splits
     def prepare_data(self, X: np.ndarray, static: Dict[str, Any]):
@@ -144,6 +212,7 @@ def _bootstrap_counts(key, w, n):
 
 
 class _RandomForestBase(_TreeBase):
+    _supports_deep = True  # sklearn RF default grows each tree to purity
     static_defaults = {
         "n_estimators": 100,
         "max_depth": None,
@@ -169,19 +238,16 @@ class _RandomForestBase(_TreeBase):
             counts = _bootstrap_counts(boot_key, C, xb.shape[0])
         else:
             counts = (C > 0).astype(jnp.float32)
-        return build_tree(
+        return self._fit_one_tree(
             xb,
             S * counts[:, None],
             C * counts,
-            depth=static["_depth"],
-            n_bins=static["_n_bins"],
-            min_samples_leaf=static["_msl"],
-            max_features=static["_mf"],
-            key=feat_key,
+            static,
+            feat_key,
             # classification stats are small-integer counts x 0/1 one-hots —
             # exact in bf16, so the fast MXU path loses nothing; regression
             # stats are continuous y*w sums and need full f32
-            precision=(
+            (
                 jax.lax.Precision.DEFAULT
                 if self.task == "classification"
                 else jax.lax.Precision.HIGHEST
@@ -211,10 +277,18 @@ class _RandomForestBase(_TreeBase):
         trees = int(static.get("n_estimators", 100))
         kk = max(int(n_classes), 2) + 1 if self.task == "classification" else 2
         depth = static["_depth"]
-        macs = (
-            float(max(n_splits, 1)) * trees * n * (2 ** max(depth - 1, 0))
-            * kk * d * static["_n_bins"]
-        )
+        if static.get("_deep"):
+            # deep arena: one W-wide histogram matmul per level past the
+            # pyramid (levels < log2 W cost 2^level, summing to ~2W)
+            W = int(static["_W"])
+            levels_eff = max(static["_levels"] - int(np.log2(W)) + 2, 2)
+            per_level = float(n) * W * kk * d * static["_n_bins"]
+            macs = float(max(n_splits, 1)) * trees * levels_eff * per_level
+        else:
+            macs = (
+                float(max(n_splits, 1)) * trees * n * (2 ** max(depth - 1, 0))
+                * kk * d * static["_n_bins"]
+            )
         n_chunks = int(np.ceil(macs / chunk_macs))
         if n_chunks <= 1:
             return None
@@ -245,7 +319,7 @@ class _RandomForestBase(_TreeBase):
             t = chunk_idx * g + i
             key = jax.random.fold_in(base_key, t)
             tree = self._one_tree(xb, S, C, static, key)
-            val = predict_tree(xb, tree, static["_depth"])  # [n, k]
+            val = self._tree_predict(xb, tree, static)  # [n, k]
             live = (t < n_trees).astype(jnp.float32)
             return carry + live * val, None
 
@@ -286,10 +360,9 @@ class _RandomForestBase(_TreeBase):
 
     def _forest_leaf_mean(self, params, xq, static):
         trees = params["trees"]
-        depth = static["_depth"]
 
         def one(tree):
-            return predict_tree(xq, tree, depth)
+            return self._tree_predict(xq, tree, static)
 
         vals = jax.lax.map(one, trees)  # [n_trees, nq, k]
         return jnp.mean(vals, axis=0)

@@ -108,6 +108,58 @@ def _level_histogram(local, xb, SC, n_nodes: int, n_bins: int, precision=None):
     return H.reshape(n_nodes, kk, d, n_bins).transpose(0, 2, 3, 1)
 
 
+def _split_gain(H, k: int, n_bins: int, min_samples_leaf: float):
+    """Per-(node, feature, bin) split gain from a histogram.
+
+    H: [m, d, n_bins, k+1] (stats + count). Returns gain [m, d, n_bins] with
+    invalid candidates at -inf. The score is the unified S^2/C proxy (gini /
+    variance / Newton gain depending on what S, C carry); identical math to
+    the level-wise builder's inline version.
+    """
+    Sh = H[..., :k]
+    Ch = jnp.maximum(H[..., k], 0.0)
+    Scum = jnp.cumsum(Sh, axis=2)  # left stats for split at bin b
+    Ccum = jnp.cumsum(Ch, axis=2)
+    S_tot = Scum[:, :, -1:, :]
+    C_tot = Ccum[:, :, -1:]
+    Sr = S_tot - Scum
+    Cr = C_tot - Ccum
+    gain = jnp.sum(Scum**2, -1) / jnp.maximum(Ccum, _EPS) + jnp.sum(
+        Sr**2, -1
+    ) / jnp.maximum(Cr, _EPS)
+    parent = jnp.sum(S_tot**2, -1) / jnp.maximum(C_tot, _EPS)  # [m, d, 1]
+    valid = (Ccum >= min_samples_leaf) & (Cr >= min_samples_leaf)
+    # last bin = degenerate split (empty right)
+    valid = valid & (jnp.arange(n_bins)[None, None, :] < n_bins - 1)
+    return jnp.where(valid, gain - parent, -jnp.inf)
+
+
+def _pick_best(gain, n_bins: int):
+    """argmax over (feature, bin) per node: (best_gain, feat, bin)."""
+    m = gain.shape[0]
+    flat = gain.reshape(m, -1)
+    best = jnp.argmax(flat, axis=1)
+    bg = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+    bf = (best // n_bins).astype(jnp.int32)
+    bb = (best % n_bins).astype(jnp.int32)
+    return bg, bf, bb
+
+
+def _node_feature_mask(gain, node_ids, key, max_features: Optional[int], d: int):
+    """RF per-node feature subsets for the deep builder, keyed by arena node
+    id (fold_in) so chunked/monolithic fits draw identical subsets."""
+    if max_features is None or max_features >= d:
+        return gain
+
+    def one(cid):
+        return jax.random.uniform(jax.random.fold_in(key, cid), (d,))
+
+    u = jax.vmap(one)(jnp.maximum(node_ids, 0))
+    thresh = jnp.sort(u, axis=1)[:, max_features - 1 : max_features]
+    allowed = u <= thresh
+    return jnp.where(allowed[:, :, None], gain, -jnp.inf)
+
+
 def build_tree(
     xb,
     S,
@@ -168,24 +220,7 @@ def build_tree(
                 n_nodes, d, n_bins, k + 1
             )
         H_prev = H
-        Sh = H[..., :k]
-        Ch = jnp.maximum(H[..., k], 0.0)
-
-        Scum = jnp.cumsum(Sh, axis=2)  # left stats for split at bin b
-        Ccum = jnp.cumsum(Ch, axis=2)
-        S_tot = Scum[:, :, -1:, :]
-        C_tot = Ccum[:, :, -1:]
-
-        Sr = S_tot - Scum
-        Cr = C_tot - Ccum
-        gain = jnp.sum(Scum**2, -1) / jnp.maximum(Ccum, _EPS) + jnp.sum(
-            Sr**2, -1
-        ) / jnp.maximum(Cr, _EPS)
-        parent = jnp.sum(S_tot**2, -1) / jnp.maximum(C_tot, _EPS)  # [nodes, d, 1]
-        valid = (Ccum >= min_samples_leaf) & (Cr >= min_samples_leaf)
-        # last bin = degenerate split (empty right)
-        valid = valid & (jnp.arange(n_bins)[None, None, :] < n_bins - 1)
-        gain = jnp.where(valid, gain - parent, -jnp.inf)
+        gain = _split_gain(H, k, n_bins, min_samples_leaf)
 
         if max_features is not None and max_features < d:
             key, sub = jax.random.split(key)
@@ -194,11 +229,7 @@ def build_tree(
             allowed = u <= thresh
             gain = jnp.where(allowed[:, :, None], gain, -jnp.inf)
 
-        flat = gain.reshape(n_nodes, d * n_bins)
-        best = jnp.argmax(flat, axis=1)
-        best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        bf = (best // n_bins).astype(jnp.int32)
-        bb = (best % n_bins).astype(jnp.int32)
+        best_gain, bf, bb = _pick_best(gain, n_bins)
         do_split = best_gain > 1e-7
         bf = jnp.where(do_split, bf, 0)
         bb = jnp.where(do_split, bb, n_bins - 1)
@@ -222,6 +253,155 @@ def build_tree(
         "leaf_val": leaf_val,
         "leaf_weight": Cl,
     }
+
+
+def build_tree_deep(
+    xb,
+    S,
+    C,
+    *,
+    levels: int,
+    width: int,
+    n_bins: int,
+    min_samples_leaf: float = 1.0,
+    max_features: Optional[int] = None,
+    key=None,
+    precision=jax.lax.Precision.HIGHEST,
+) -> Dict[str, jnp.ndarray]:
+    """Deep tree via frontier-compacted level-wise growth (batched best-first).
+
+    The complete-tree builder above pays 2^level histogram rows per level —
+    infeasible past depth ~10. sklearn's ``max_depth=None`` grows to purity
+    (depth 25-45 on Covertype-scale data, the reference's exact-CART fit at
+    ``aws-prod/worker/worker.py:315``), so this builder keeps an *arena* of
+    nodes and, per level, histograms only an active frontier of at most
+    ``width`` nodes:
+
+    - each level: split every frontier node whose best gain is positive;
+      histogram the LEFT children mapped to parent slots (one matmul with
+      one-hot dim ``width``), derive right children by subtraction — so both
+      children's exact best-split gains are known for the cost of one
+      histogram;
+    - the next frontier = top-``width`` children by their OWN best gain
+      (``lax.top_k``) — true-gain best-first selection, not a proxy; children
+      not selected (budget) or unsplittable (gain <= eps, min_samples_leaf)
+      become leaves;
+    - per-level cost is O(n * width * kk * d * n_bins) MACs regardless of
+      depth, all on the MXU; total leaf budget ~ width * levels (~12k at the
+      defaults), the regime sklearn's grow-to-purity needs.
+
+    Shapes are static: the frontier width at level l is min(2^l, width)
+    (early levels don't pay the full budget), the arena is a fixed
+    ``2*width*levels + 2`` slots, and routing state is one int32 per sample.
+    Returns {"feat","bin","child" [A+1], "leaf_val" [A+1, k]}; ``child`` is
+    the left-child arena id (0 = leaf; right child = left + 1).
+    """
+    n, d = xb.shape
+    k = S.shape[1]
+    S = S.astype(jnp.float32)
+    C = C.astype(jnp.float32)
+    A = 2 * width * levels + 2  # arena capacity; index A = scratch slot
+    SC = jnp.concatenate([S, C[:, None]], axis=1)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    feat_a = jnp.zeros((A + 1,), jnp.int32)
+    bin_a = jnp.full((A + 1,), n_bins - 1, jnp.int32)
+    child_a = jnp.zeros((A + 1,), jnp.int32)
+    node = jnp.zeros((n,), jnp.int32)
+    n_alloc = jnp.int32(1)
+
+    # root: full histogram + its best split
+    frontier = jnp.zeros((1,), jnp.int32)
+    H = _level_histogram(node, xb, SC, 1, n_bins, precision)
+    g = _split_gain(H, k, n_bins, min_samples_leaf)
+    g = _node_feature_mask(g, frontier, key, max_features, d)
+    gain, bf, bb = _pick_best(g, n_bins)
+
+    for level in range(levels):
+        W_l = frontier.shape[0]
+        do_split = (gain > 1e-7) & (frontier >= 0)
+        rank_inc = jnp.cumsum(do_split.astype(jnp.int32))
+        do_split = do_split & (n_alloc + 2 * rank_inc <= A)
+        rank_inc = jnp.cumsum(do_split.astype(jnp.int32))
+        rank_exc = rank_inc - do_split.astype(jnp.int32)
+        left_id = n_alloc + 2 * rank_exc
+
+        # write split records; masked rows land in the scratch slot A
+        idx = jnp.where(do_split, frontier, A)
+        feat_a = feat_a.at[idx].set(jnp.where(do_split, bf, 0))
+        bin_a = bin_a.at[idx].set(jnp.where(do_split, bb, n_bins - 1))
+        child_a = child_a.at[idx].set(jnp.where(do_split, left_id, 0))
+
+        # route samples sitting in split nodes to their children
+        slot_tab = jnp.full((A + 1,), W_l, jnp.int32)
+        slot_tab = slot_tab.at[jnp.where(frontier >= 0, frontier, A)].set(
+            jnp.arange(W_l, dtype=jnp.int32)
+        )
+        slot_tab = slot_tab.at[A].set(W_l)  # scratch writes above must stay dead
+        slot = slot_tab[node]  # [n], == W_l when not in frontier
+        pad_b = jnp.zeros((1,), jnp.int32)
+        sp = jnp.concatenate([do_split, jnp.zeros((1,), bool)])[slot]
+        f_i = jnp.concatenate([bf, pad_b])[slot]
+        b_i = jnp.concatenate([bb, pad_b])[slot]
+        l_i = jnp.concatenate([left_id, pad_b])[slot]
+        go_left = xb[jnp.arange(n), f_i] <= b_i
+        node = jnp.where(sp, l_i + 1 - go_left.astype(jnp.int32), node)
+        n_alloc = n_alloc + 2 * rank_inc[-1]
+
+        if level == levels - 1:
+            break  # children of the last level are leaves
+
+        # children's histograms: left by matmul over parent slots, right by
+        # subtraction (exact for integer stats; float tails are gain-clamped)
+        local_left = jnp.where(sp & go_left, slot, W_l)
+        H_L = _level_histogram(local_left, xb, SC, W_l, n_bins, precision)
+        H_R = H - H_L
+        cand_H = jnp.concatenate([H_L, H_R], axis=0)  # [2*W_l, d, bins, k+1]
+        cand_id = jnp.concatenate(
+            [jnp.where(do_split, left_id, -1), jnp.where(do_split, left_id + 1, -1)]
+        )
+        cg = _split_gain(cand_H, k, n_bins, min_samples_leaf)
+        cg = _node_feature_mask(cg, cand_id, key, max_features, d)
+        cgain, cbf, cbb = _pick_best(cg, n_bins)
+        cgain = jnp.where(cand_id >= 0, cgain, -jnp.inf)
+
+        W_next = min(2 * W_l, width)
+        vals, sel = jax.lax.top_k(cgain, W_next)
+        live = vals > -jnp.inf
+        frontier = jnp.where(live, cand_id[sel], -1)
+        gain = vals
+        bf = cbf[sel]
+        bb = cbb[sel]
+        H = cand_H[sel]
+
+    leaf_S = jax.ops.segment_sum(S, node, num_segments=A + 1)
+    leaf_C = jax.ops.segment_sum(C, node, num_segments=A + 1)
+    leaf_val = leaf_S / jnp.maximum(leaf_C, _EPS)[:, None]
+    return {
+        "feat": feat_a,
+        "bin": bin_a,
+        "child": child_a,
+        "leaf_val": leaf_val,
+        "leaf_weight": leaf_C,
+    }
+
+
+@partial(jax.jit, static_argnames=("levels",))
+def _route_deep(xb, feat, bins, child, levels: int):
+    n = xb.shape[0]
+    node = jnp.zeros((n,), jnp.int32)
+    for _ in range(levels):
+        c = child[node]
+        go_left = xb[jnp.arange(n), feat[node]] <= bins[node]
+        node = jnp.where(c > 0, c + 1 - go_left.astype(jnp.int32), node)
+    return node
+
+
+def predict_tree_deep(xb, tree, levels: int):
+    """Leaf values for binned query rows against an arena tree."""
+    leaf = _route_deep(xb, tree["feat"], tree["bin"], tree["child"], levels)
+    return tree["leaf_val"][leaf]
 
 
 @partial(jax.jit, static_argnames=("depth",))

@@ -68,6 +68,39 @@ class Coordinator:
         self._artifact_lock = threading.Lock()
         self._artifact_specs: Dict[Any, Dict[str, Any]] = {}
         self._artifact_paths: Dict[Any, str] = {}
+        if journal:
+            self.resume_inflight()
+
+    def resume_inflight(self) -> List[str]:
+        """Re-dispatch jobs the journal shows as unfinished: replay restores
+        state, this restores WORK — a coordinator killed mid-job completes it
+        after restart without client resubmission (beyond the reference,
+        whose master restart loses in-flight jobs; Redis AOF only kept
+        state, SURVEY.md §5.4). Subtasks with a journaled terminal result
+        are not re-run."""
+        resumed = []
+        for sid, job_id in self.store.unfinished_jobs():
+            job = self.store.get_job(sid, job_id)
+            specs = [sub["spec"] for sub in job["subtasks"].values()]
+            existing = {
+                stid: sub["result"]
+                for stid, sub in job["subtasks"].items()
+                if sub["status"] in ("completed", "failed") and sub["result"]
+            }
+            logger.info(
+                "Resuming job %s: %d/%d subtasks already journaled",
+                job_id, len(existing), len(specs),
+            )
+            t = threading.Thread(
+                target=self._run_job,
+                args=(sid, job_id, specs),
+                kwargs={"existing": existing},
+                daemon=True,
+            )
+            self._job_threads[job_id] = t
+            t.start()
+            resumed.append(job_id)
+        return resumed
 
     # ------------- session / data management (master.py:56-112 parity) -------------
 
@@ -157,7 +190,17 @@ class Coordinator:
             "total_subtasks": len(subtasks),
         }
 
-    def _run_job(self, sid: str, job_id: str, subtasks: List[Dict[str, Any]]) -> None:
+    def _run_job(
+        self,
+        sid: str,
+        job_id: str,
+        subtasks: List[Dict[str, Any]],
+        existing: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> None:
+        """Execute a job's subtasks and aggregate. ``existing`` (resume path)
+        maps already-finished subtask ids to their journaled results; only
+        the remainder is dispatched."""
+
         def on_result(subtask_id: str, status: str, result: Optional[Dict[str, Any]]):
             self.store.update_subtask(sid, job_id, subtask_id, status, result)
             self.bus.publish(TOPIC_RESULTS, result, key=subtask_id)
@@ -165,13 +208,21 @@ class Coordinator:
         def on_metrics(msg: Dict[str, Any]):
             self.bus.publish(TOPIC_METRICS, msg, key=msg.get("subtask_id"))
 
+        existing = existing or {}
+        remaining = [st for st in subtasks if st["subtask_id"] not in existing]
         try:
-            if self.cluster is not None:
-                results = self._run_job_scheduled(sid, job_id, subtasks, on_result)
+            if not remaining:
+                new_results: List[Dict[str, Any]] = []
+            elif self.cluster is not None:
+                new_results = self._run_job_scheduled(sid, job_id, remaining, on_result)
             else:
-                results = self.executor.run_subtasks(
-                    subtasks, on_result=on_result, on_metrics=on_metrics
+                new_results = self.executor.run_subtasks(
+                    remaining, on_result=on_result, on_metrics=on_metrics
                 )
+            by_id = dict(existing)
+            for st, r in zip(remaining, new_results):
+                by_id[st["subtask_id"]] = r
+            results = [by_id.get(st["subtask_id"]) for st in subtasks]
             self._aggregate(sid, job_id, subtasks, results)
         except Exception as e:  # noqa: BLE001
             logger.exception("Job %s failed", job_id)
@@ -192,21 +243,45 @@ class Coordinator:
             job = self.store.get_job(sid, job_id)
             self.cluster.submit(subtasks, metadata=job.get("metadata") or None)
             pending = set(wanted)
-            deadline = time.time() + self.config.service.client_timeout_s
-            while pending and time.time() < deadline:
+            # Progress-aware liveness, not a wall-clock deadline: a long job
+            # whose executors are still productively computing must not be
+            # failed server-side. The job times out only when BOTH hold for
+            # client_timeout_s: no result arrived, AND no live worker owns
+            # any of its pending tasks (a placed task stays in its worker's
+            # queue until the metrics feedback clears it).
+            stall_grace = self.config.service.client_timeout_s
+            # ownership proves placement, not computation: a wedged worker
+            # whose heartbeat thread survives would hold its queue entry
+            # forever, so a generous hard bound restores eventual liveness
+            hard_deadline = time.time() + 20.0 * stall_grace
+            last_progress = time.time()
+            while pending:
+                if time.time() > hard_deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} subtasks unfinished at the hard "
+                        f"deadline ({20.0 * stall_grace:.0f}s)"
+                    )
                 try:
                     stid, result = sub.get(timeout=0.5)
                 except _q.Empty:
+                    if time.time() - last_progress > stall_grace:
+                        owned: set = set()
+                        for q in self.cluster.engine.queue_snapshot().values():
+                            owned.update(q)
+                        if not (pending & owned):
+                            raise TimeoutError(
+                                f"{len(pending)} subtasks stalled with no live "
+                                f"owner for {stall_grace:.0f}s "
+                                f"(e.g. {sorted(pending)[:3]})"
+                            )
+                        last_progress = time.time()  # workers still own tasks
                     continue
                 if stid not in pending:
                     continue  # duplicate delivery after a requeue
                 pending.discard(stid)
                 results[wanted[stid]] = result
                 on_result(stid, result.get("status", "completed"), result)
-            if pending:
-                raise TimeoutError(
-                    f"{len(pending)} subtasks never reported (e.g. {sorted(pending)[:3]})"
-                )
+                last_progress = time.time()
             return results  # type: ignore[return-value]
         finally:
             sub.close()

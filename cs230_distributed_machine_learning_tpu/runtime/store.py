@@ -167,6 +167,17 @@ class JobStore:
                 else None,
             }
 
+    def unfinished_jobs(self) -> List[tuple]:
+        """(sid, job_id) of jobs not yet finalized — after a journal replay
+        these are the in-flight jobs a restarted coordinator must resume."""
+        with self._lock:
+            return [
+                (sid, jid)
+                for sid, sess in self._sessions.items()
+                for jid, job in sess["jobs"].items()
+                if job["status"] not in ("completed", "failed")
+            ]
+
     def subtask_results(self, sid: str, job_id: str) -> List[Dict[str, Any]]:
         with self._lock:
             job = self._require_job(sid, job_id)

@@ -82,13 +82,36 @@ def _generation() -> str:
     ).hexdigest()[:16]
 
 
-def _prune_stale_generations(root: str, keep: str) -> None:
-    import shutil
+_PRUNE_AGE_S = 7 * 24 * 3600
+_PRUNED = False
 
+
+def _prune_stale_generations(root: str, keep: str) -> None:
+    """Drop superseded generation dirs, but only ones untouched for
+    _PRUNE_AGE_S and only once per process: two live processes on different
+    code/jax versions sharing a storage root must not delete each other's
+    active caches on every write (they'd silently degrade both to
+    re-tracing, and could race a sibling's in-flight tmp file)."""
+    import shutil
+    import time
+
+    global _PRUNED
+    if _PRUNED:
+        return
+    _PRUNED = True
+    now = time.time()
     try:
         for name in os.listdir(root):
             path = os.path.join(root, name)
-            if name != keep and os.path.isdir(path):
+            if name == keep or not os.path.isdir(path):
+                continue
+            try:
+                ages = [os.path.getmtime(path)]
+                with os.scandir(path) as it:
+                    ages += [e.stat().st_mtime for e in it]
+            except OSError:
+                continue
+            if now - max(ages) > _PRUNE_AGE_S:
                 shutil.rmtree(path, ignore_errors=True)
     except OSError:
         pass

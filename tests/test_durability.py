@@ -109,3 +109,71 @@ def test_wait_job_is_event_driven():
         assert store.wait_job(sid, "j", timeout=0.0) is True  # already done
     finally:
         t.cancel()
+
+
+def test_coordinator_resumes_inflight_job():
+    """A coordinator killed mid-job must complete the job after restart with
+    NO client resubmission: journal replay restores state, resume_inflight
+    re-dispatches the subtasks that never reported."""
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    # simulate the dead coordinator's journal: job created, 1 of 3 subtasks
+    # completed, never finalized (the process died here)
+    jd = get_config().storage.journal_dir
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    model_details = {
+        "model_type": "LogisticRegression",
+        "search_type": "GridSearchCV",
+        "base_estimator_params": {"max_iter": 300},
+        "param_grid": {"C": [0.1, 1.0, 10.0]},
+    }
+    subtasks = create_subtasks("jobr", sid, "iris", model_details, {"cv": 3})
+    assert len(subtasks) == 3
+    store.create_job(sid, "jobr", {"dataset_id": "iris"}, subtasks)
+    store.update_subtask(
+        sid, "jobr", subtasks[0]["subtask_id"], "completed",
+        {"subtask_id": subtasks[0]["subtask_id"], "status": "completed",
+         "mean_cv_score": 0.91, "accuracy": 0.9},
+    )
+    del store
+
+    # restart: resume_inflight dispatches the 2 unreported subtasks
+    coord = Coordinator(journal=True)
+    assert coord.store.wait_job(sid, "jobr", timeout=120)
+    status = coord.check_status(sid, "jobr")
+    assert status["job_status"] == "completed"
+    results = status["job_result"]["results"]
+    assert len(results) == 3  # 1 journaled + 2 re-run
+    fresh = [r for r in results if r["mean_cv_score"] != 0.91]
+    assert len(fresh) >= 2 and all(r["status"] == "completed" for r in fresh)
+
+
+def test_resume_with_all_subtasks_done_just_aggregates():
+    """Coordinator died between last result and finalize: resume must
+    aggregate without re-running anything."""
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    jd = get_config().storage.journal_dir
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    md = {"model_type": "LogisticRegression", "search_type": None,
+          "base_estimator_params": {"max_iter": 300}}
+    subtasks = create_subtasks("jobd", sid, "iris", md, {})
+    store.create_job(sid, "jobd", {"dataset_id": "iris"}, subtasks)
+    for st in subtasks:
+        store.update_subtask(
+            sid, "jobd", st["subtask_id"], "completed",
+            {"subtask_id": st["subtask_id"], "status": "completed",
+             "mean_cv_score": 0.88, "accuracy": 0.9},
+        )
+    del store
+
+    coord = Coordinator(journal=True)
+    assert coord.store.wait_job(sid, "jobd", timeout=30)
+    res = coord.check_status(sid, "jobd")["job_result"]
+    assert res["best_result"]["mean_cv_score"] == 0.88

@@ -116,3 +116,72 @@ def test_forest_grid_through_pipeline():
     assert len(status["job_result"]["results"]) == 4
     best = status["job_result"]["best_result"]
     assert best["mean_cv_score"] > 0.9
+
+
+# ---------------------------------------------------------------------------
+# deep (frontier-compacted arena) builder — the grow-to-purity path sklearn
+# uses for max_depth=None on large data (reference worker.py:315 fits exact
+# CART); engaged above the CS230_TREE_DEEP_N sample threshold
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def deep_data():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=2500,
+        n_features=12,
+        n_informative=8,
+        n_classes=4,
+        n_clusters_per_class=3,
+        random_state=0,
+    )
+    data = TrialData(X=X.astype(np.float32), y=y.astype(np.int32), n_classes=4)
+    plan = build_split_plan(y, task="classification", n_folds=3)
+    return data, plan, X.astype(np.float32), y
+
+
+def test_deep_decision_tree_parity(deep_data, monkeypatch):
+    """max_depth=None above the deep threshold must reach sklearn's
+    grow-to-purity CV, which the depth-10 complete tree cannot."""
+    from sklearn.model_selection import cross_val_score
+    from sklearn.tree import DecisionTreeClassifier
+
+    monkeypatch.setenv("CS230_TREE_DEEP_N", "1000")
+    data, plan, X, y = deep_data
+    kernel = get_kernel("DecisionTreeClassifier")
+    static = kernel.resolve_static({"max_depth": None}, X.shape[0], X.shape[1], 4)
+    assert static.get("_deep") and static["_levels"] > 14  # deep mode engaged
+    out = run_trials(kernel, data, plan, [{"random_state": 0}])
+    m = out.trial_metrics[0]
+    sk_cv = cross_val_score(DecisionTreeClassifier(random_state=0), X, y, cv=3).mean()
+    assert m["mean_cv_score"] > sk_cv - 0.06, (m["mean_cv_score"], sk_cv)
+
+
+def test_deep_forest_parity(deep_data, monkeypatch):
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.model_selection import cross_val_score
+
+    monkeypatch.setenv("CS230_TREE_DEEP_N", "1000")
+    data, plan, X, y = deep_data
+    kernel = get_kernel("RandomForestClassifier")
+    out = run_trials(kernel, data, plan, [{"n_estimators": 10, "random_state": 0}])
+    m = out.trial_metrics[0]
+    sk_cv = cross_val_score(
+        RandomForestClassifier(n_estimators=10, random_state=0), X, y, cv=3
+    ).mean()
+    assert m["mean_cv_score"] > sk_cv - 0.06, (m["mean_cv_score"], sk_cv)
+
+
+def test_deep_forest_chunked_matches_monolithic(deep_data, monkeypatch):
+    """fold_in(t) per-tree streams make the chunked and monolithic deep
+    fits identical (same guarantee the complete-tree path has)."""
+    data, plan, X, y = deep_data
+    monkeypatch.setenv("CS230_TREE_DEEP_N", "1000")
+    kernel = get_kernel("RandomForestClassifier")
+    params = [{"n_estimators": 6, "random_state": 3}]
+    mono = run_trials(kernel, data, plan, params).trial_metrics[0]
+    monkeypatch.setenv("CS230_TREE_CHUNK_MACS", "2e9")  # force several chunks
+    chunked = run_trials(kernel, data, plan, params).trial_metrics[0]
+    assert chunked["mean_cv_score"] == pytest.approx(mono["mean_cv_score"], abs=1e-6)
