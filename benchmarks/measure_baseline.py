@@ -26,13 +26,14 @@ from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinat
 
 
 def _sk_trial(model, X, y, cv=5):
-    """One reference-style trial: holdout fit + eval + full-data k-fold CV."""
+    """One reference-style trial: holdout fit + eval + full-data k-fold CV.
+    Returns the trial's mean CV score (for the accuracy-parity columns)."""
     from sklearn.model_selection import cross_val_score, train_test_split
 
     Xt, Xe, yt, ye = train_test_split(X, y, test_size=0.2, random_state=42)
     model.fit(Xt, yt)
     model.score(Xe, ye)
-    cross_val_score(model, X, y, cv=cv)
+    return float(cross_val_score(model, X, y, cv=cv).mean())
 
 
 def _ours(manager, estimator, dataset, n_expected=None):
@@ -108,11 +109,29 @@ def main() -> None:
     )
     from sklearn.neural_network import MLPClassifier
 
+    from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+    from cs230_distributed_machine_learning_tpu.utils.flops import (
+        analytical_flops,
+        mfu,
+    )
+
     manager = MLTaskManager(coordinator=Coordinator())
     cache = manager._coordinator.cache
     report = []
 
-    def record(name, sk_time, sk_extrapolated, our_time, steady_time, n_trials, note=""):
+    def _flops_mfu(model_name, statics, n, d, n_classes, n_trials, steady_s):
+        """Model-analytical FLOPs + achieved MFU for a config (None when the
+        kernel has no estimate or the run was host-executed)."""
+        kernel = get_kernel(model_name)
+        static = kernel.resolve_static(dict(statics), n, d, n_classes)
+        static["_n_classes"] = n_classes
+        if hasattr(kernel, "bucket_static"):
+            static = kernel.bucket_static(static, [statics])
+        fl = analytical_flops(kernel, static, n, d, 6, n_trials)
+        return fl, mfu(fl, steady_s)
+
+    def record(name, sk_time, sk_extrapolated, our_time, steady_time, n_trials,
+               note="", flops=None, util=None, cv_ours=None, cv_sk=None):
         report.append(
             {
                 "config": name,
@@ -123,54 +142,84 @@ def main() -> None:
                 "speedup": round(sk_time / our_time, 2) if our_time else None,
                 "speedup_steady": round(sk_time / steady_time, 2) if steady_time else None,
                 "n_trials": n_trials,
+                "flops": flops,
+                "mfu": round(util, 4) if util is not None else None,
+                "best_cv_ours": round(cv_ours, 4) if cv_ours is not None else None,
+                "best_cv_sklearn": round(cv_sk, 4) if cv_sk is not None else None,
                 "note": note,
             }
         )
         print(f"{name}: sklearn {sk_time:.1f}s  ours {our_time:.1f}s "
               f"(steady {steady_time:.1f}s)  ({sk_time / our_time:.1f}x / "
-              f"steady {sk_time / steady_time:.1f}x)  [{n_trials} trials]")
+              f"steady {sk_time / steady_time:.1f}x)  [{n_trials} trials]"
+              + (f"  cv {cv_ours:.3f} vs sk {cv_sk:.3f}" if cv_ours is not None
+                 and cv_sk is not None else "")
+              + (f"  mfu {util:.1%}" if util is not None else ""))
 
     # ---- 1. RandomForestClassifier on iris (plain fit) ----
     data = cache.get("iris", "classification")
     X, y = np.asarray(data.X), np.asarray(data.y)
     t0 = time.time()
-    _sk_trial(RandomForestClassifier(random_state=42), X, y)
+    sk_cv1 = _sk_trial(RandomForestClassifier(random_state=42), X, y)
     sk = time.time() - t0
-    ours, steady, n, _ = _ours(manager, RandomForestClassifier(n_estimators=100, random_state=42), "iris", 1)
-    record("1. RandomForestClassifier iris (plain)", sk, False, ours, steady, n)
+    ours, steady, n, best = _ours(manager, RandomForestClassifier(n_estimators=100, random_state=42), "iris", 1)
+    fl, util = _flops_mfu("RandomForestClassifier",
+                          {"n_estimators": 100, "random_state": 42},
+                          len(X), X.shape[1], 3, 1, steady)
+    record("1. RandomForestClassifier iris (plain)", sk, False, ours, steady, n,
+           flops=fl, util=util, cv_ours=best["mean_cv_score"], cv_sk=sk_cv1)
 
     # ---- 2. LogisticRegression GridSearchCV on iris (8-cell, cv=5) ----
     grid = {"C": [0.01, 0.1, 1.0, 10.0], "fit_intercept": [True, False]}
     t0 = time.time()
-    for combo in ParameterGrid(grid):
+    sk_cvs = [
         _sk_trial(LogisticRegression(max_iter=1000, **combo), X, y)
+        for combo in ParameterGrid(grid)
+    ]
     sk = time.time() - t0
     ours, steady, n, best = _ours(
         manager, GridSearchCV(LogisticRegression(max_iter=1000), grid, cv=5), "iris", 8
     )
     sk_search = GridSearchCV(LogisticRegression(max_iter=1000), grid, cv=5).fit(X, y)
     parity = best["search_params"]["C"] == sk_search.best_params_["C"]
+    fl, util = _flops_mfu("LogisticRegression",
+                          {"fit_intercept": True, "penalty": "l2", "max_iter": 1000},
+                          len(X), X.shape[1], 3, 8, steady)
     record("2. LogReg GridSearchCV iris 8-cell", sk, False, ours, steady, n,
-           note=f"best_params match sklearn: {parity}")
+           note=f"best_params match sklearn: {parity}",
+           flops=fl, util=util, cv_ours=best["mean_cv_score"], cv_sk=max(sk_cvs))
 
     # ---- 3. RandomizedSearchCV LogReg on Covertype (1000 trials) ----
     data = cache.get("covertype", "classification")
     Xc, yc = np.asarray(data.X), np.asarray(data.y)
     dists = {"C": loguniform(1e-3, 1e2)}
-    sample = list(ParameterSampler(dists, n_iter=2, random_state=0))
-    t0 = time.time()
-    for combo in sample:
-        _sk_trial(LogisticRegression(max_iter=200, **combo), Xc, yc)
-    sk = (time.time() - t0) / len(sample) * 1000
-    ours, steady, n, _ = _ours(
+    # stratified-by-C subsample of the actual 1000-trial population (cost
+    # varies strongly with C; 2 random draws made the extrapolation soft)
+    population = sorted(
+        ParameterSampler(dists, n_iter=1000, random_state=0), key=lambda p: p["C"]
+    )
+    pos = np.linspace(0, len(population) - 1, 8).round().astype(int)
+    sk_times, sk_cvs = [], []
+    for combo in (population[i] for i in pos):
+        t0 = time.time()
+        sk_cvs.append(_sk_trial(LogisticRegression(max_iter=200, **combo), Xc, yc))
+        sk_times.append(time.time() - t0)
+    sk = float(np.mean(sk_times)) * 1000
+    ours, steady, n, best = _ours(
         manager,
         RandomizedSearchCV(LogisticRegression(max_iter=200), dists, n_iter=1000,
                            cv=5, random_state=0),
         "covertype",
         1000,
     )
+    fl, util = _flops_mfu("LogisticRegression",
+                          {"fit_intercept": True, "penalty": "l2", "max_iter": 200},
+                          len(Xc), Xc.shape[1], 7, 1000, steady)
     record("3. RandomizedSearch LogReg covertype 1000", sk, True, ours, steady, n,
-           note="sklearn extrapolated from 2 trials")
+           note=f"sklearn extrapolated from 8 C-stratified trials "
+                f"(rel err {np.std(sk_times) / max(np.mean(sk_times), 1e-9):.2f})",
+           flops=fl, util=util,
+           cv_ours=best["mean_cv_score"], cv_sk=max(sk_cvs))
 
     # ---- 4. GradientBoostingRegressor GridSearchCV on titanic ----
     manager.download_data("titanic", "titanic", "builtin")
@@ -183,27 +232,44 @@ def main() -> None:
     Xt, yt = np.asarray(data.X), np.asarray(data.y)
     ggrid = {"n_estimators": [50, 100], "learning_rate": [0.05, 0.1]}
     t0 = time.time()
-    for combo in ParameterGrid(ggrid):
+    sk_cvs = [
         _sk_trial(GradientBoostingRegressor(random_state=0, **combo), Xt, yt)
+        for combo in ParameterGrid(ggrid)
+    ]
     sk = time.time() - t0
-    ours, steady, n, _ = _ours(
+    ours, steady, n, best = _ours(
         manager, GridSearchCV(GradientBoostingRegressor(random_state=0), ggrid, cv=5),
         "titanic", 4,
     )
-    record("4. GBRegressor GridSearchCV titanic (yaml)", sk, False, ours, steady, n)
+    # sum per-combo FLOPs (the grid halves on n_estimators: 2x50 + 2x100)
+    fl = sum(
+        _flops_mfu("GradientBoostingRegressor",
+                   {"n_estimators": ne, "random_state": 0},
+                   len(Xt), Xt.shape[1], 0, 2, steady)[0]
+        for ne in (50, 100)
+    )
+    from cs230_distributed_machine_learning_tpu.utils.flops import mfu as _mfu
+
+    util = _mfu(fl, steady)
+    record("4. GBRegressor GridSearchCV titanic (yaml)", sk, False, ours, steady, n,
+           flops=fl, util=util, cv_ours=best["mean_cv_score"], cv_sk=max(sk_cvs))
 
     # ---- 5. MLPClassifier RandomizedSearchCV on MNIST-shaped data ----
     mnist = "synthetic_10000x784x10"
     data = cache.get(mnist, "classification")
     Xm, ym = np.asarray(data.X), np.asarray(data.y)
     mdists = {"learning_rate_init": [1e-4, 1e-3, 1e-2], "alpha": [1e-5, 1e-4, 1e-3]}
-    msample = list(ParameterSampler(mdists, n_iter=2, random_state=0))
-    t0 = time.time()
+    # per-trial cost is hyper-invariant here (fixed arch/epochs: lr and
+    # alpha don't change the work), so 4 draws bound the mean tightly
+    msample = list(ParameterSampler(mdists, n_iter=4, random_state=0))
+    sk_times, sk_cvs = [], []
     for combo in msample:
-        _sk_trial(MLPClassifier(hidden_layer_sizes=(128,), max_iter=30,
-                                random_state=0, **combo), Xm, ym)
-    sk = (time.time() - t0) / len(msample) * 8
-    ours, steady, n, _ = _ours(
+        t0 = time.time()
+        sk_cvs.append(_sk_trial(MLPClassifier(hidden_layer_sizes=(128,), max_iter=30,
+                                              random_state=0, **combo), Xm, ym))
+        sk_times.append(time.time() - t0)
+    sk = float(np.mean(sk_times)) * 8
+    ours, steady, n, best = _ours(
         manager,
         RandomizedSearchCV(
             MLPClassifier(hidden_layer_sizes=(128,), max_iter=30, random_state=0),
@@ -212,8 +278,14 @@ def main() -> None:
         mnist,
         8,
     )
+    fl, util = _flops_mfu("MLPClassifier",
+                          {"hidden_layer_sizes": (128,), "max_iter": 30,
+                           "random_state": 0},
+                          len(Xm), Xm.shape[1], 10, 8, steady)
     record("5. MLP RandomizedSearch MNIST-shaped 8", sk, True, ours, steady, n,
-           note="sklearn extrapolated from 2 trials")
+           note=f"sklearn extrapolated from 4 trials "
+                f"(rel err {np.std(sk_times) / max(np.mean(sk_times), 1e-9):.2f})",
+           flops=fl, util=util, cv_ours=best["mean_cv_score"], cv_sk=max(sk_cvs))
 
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BASELINE_MEASURED.json")
     with open(out_path, "w") as f:

@@ -325,3 +325,73 @@ class DatasetCache:
             for key in [k for k in self._cache if k[0] == dataset_id]:
                 del self._cache[key]
             self._meta.pop(dataset_id, None)
+
+
+class FetchingDatasetCache(DatasetCache):
+    """DatasetCache that fetches missing datasets from the coordinator over
+    DCN (``GET /dataset/<id>``) — the multi-host replacement for the
+    reference's shared EFS volume (docker-compose.yml:92-94, setup.sh:14-29):
+    a kaggle/HF download or YAML preprocess staged on the coordinator host
+    becomes reachable from every remote agent, fetched once and then served
+    from the local staged layout.
+
+    Resolution order: local *preprocessed* copy -> coordinator (which
+    returns ITS best: preprocessed over raw — so an agent holding only a
+    raw builtin still learns about a coordinator-side preprocess) -> local
+    raw/builtin staging.
+    """
+
+    def __init__(self, coordinator_url: str, root: Optional[str] = None,
+                 timeout_s: float = 120.0):
+        super().__init__(root=root)
+        self._url = coordinator_url.rstrip("/")
+        self._timeout_s = timeout_s
+        self._fetched: set = set()
+
+    def resolve_csv(self, dataset_id: str) -> str:
+        local_pre = find_csv(dataset_id, preprocessed=True, root=self._root)
+        if local_pre is not None:
+            return local_pre
+        if dataset_id not in self._fetched:
+            path = self._fetch(dataset_id)
+            if path is not None:
+                return path
+        return super().resolve_csv(dataset_id)
+
+    def _fetch(self, dataset_id: str) -> Optional[str]:
+        import requests
+
+        from ..utils.logging import get_logger
+
+        logger = get_logger("tpuml.data")
+        try:
+            resp = requests.get(
+                f"{self._url}/dataset/{dataset_id}", timeout=self._timeout_s
+            )
+            if resp.status_code == 404:
+                # NOT negative-cached: the dataset may be staged on the
+                # coordinator later (download_data then resubmit) and must
+                # become fetchable without an agent restart
+                return None
+            resp.raise_for_status()
+        except Exception:  # noqa: BLE001
+            logger.exception("Dataset fetch for %r failed; trying local staging",
+                             dataset_id)
+            return None
+        kind = resp.headers.get("X-Dataset-Kind", "raw")
+        base = dataset_dir(dataset_id, self._root)
+        if kind == "preprocessed":
+            out_dir = os.path.join(base, "preprocessed")
+            out = os.path.join(out_dir, f"{dataset_id}_preprocessed.csv")
+        else:
+            out_dir = base
+            out = os.path.join(out_dir, f"{dataset_id}.csv")
+        os.makedirs(out_dir, exist_ok=True)
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(resp.content)
+        os.replace(tmp, out)
+        self._fetched.add(dataset_id)
+        logger.info("Fetched dataset %s (%s, %d bytes) from coordinator",
+                    dataset_id, kind, len(resp.content))
+        return out

@@ -146,6 +146,10 @@ class _KNNBase(ModelKernel):
         # longer exists)
         return max(1.0, 4.0 * (n * d + 3 * _QUERY_BLOCK * _TRAIN_TILE) / 1e6)
 
+    def macs_estimate(self, n, d, static):
+        """Scoring-time n x n distance sweep dominates (fit is free)."""
+        return float(n) * n * max(d, 1)
+
     # ---- chunked-fit protocol (parallel/trial_map.py chunked path) ----
     # KNN "training" is free; the cost is the n_query x n_train distance
     # sweep at scoring time. Chunks split the QUERY rows: each dispatch

@@ -50,6 +50,11 @@ class LinearRegressionKernel(ModelKernel):
         A = add_intercept(X, fit_intercept)
         return A @ params
 
+    def macs_estimate(self, n, d, static):
+        """Closed-form solve cost (host-vs-accelerator placement input)."""
+        dp = d + 1
+        return float(n * dp * dp + dp**3)
+
 
 class RidgeKernel(LinearRegressionKernel):
     name = "Ridge"

@@ -139,6 +139,20 @@ class LogisticRegressionKernel(ModelKernel):
         c = max(int(static.get("_n_classes", 2)), 2)
         return max(1.0, 3.0 * 4.0 * n * c / 1e6)
 
+    def macs_estimate(self, n, d, static):
+        """Analytical per-(trial, split) cost — lets the engine route
+        sub-accelerator-scale buckets to host execution."""
+        c = max(int(static.get("_n_classes", 2)), 2)
+        newton = static.get("_method") == "newton"
+        steps = int(
+            static.get("_iters", _NEWTON_STEPS if newton else _NESTEROV_STEPS)
+        )
+        per_iter = 3.0 * n * (d + 1) * c
+        if newton:
+            dim = (d + 1) * c
+            per_iter += n * dim * (d + 1) + float(dim) ** 3
+        return steps * per_iter
+
     # ---- fused Pallas batched path (ops/pallas_logreg.py) ----------------
     #
     # On TPU, large-n nesterov buckets bypass the generic vmap engine: all

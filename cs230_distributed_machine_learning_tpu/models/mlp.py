@@ -84,6 +84,14 @@ class _MLPBase(ModelKernel):
         out = self._out_dim(static)
         return (d, *static["_hls"], out)
 
+    def macs_estimate(self, n, d, static):
+        """fwd+bwd over all layer matmuls x epochs (3x fwd MAC rule)."""
+        dims = self._dims(d, static)
+        layer_macs = sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+        bs = int(static["_bs"])
+        n_batches = max(1, n // bs)
+        return 3.0 * static["_epochs"] * n_batches * bs * layer_macs
+
     def _init(self, key, dims):
         """sklearn's Glorot-uniform init."""
         params = []

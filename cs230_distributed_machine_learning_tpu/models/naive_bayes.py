@@ -13,7 +13,6 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 
-from ..ops.trees import build_tree, predict_tree
 from .base import ModelKernel
 from .trees import _TreeBase
 

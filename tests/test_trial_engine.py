@@ -6,6 +6,7 @@ from sklearn.datasets import load_iris
 from cs230_distributed_machine_learning_tpu.models.base import TrialData
 from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
 from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+from cs230_distributed_machine_learning_tpu.parallel import trial_map
 from cs230_distributed_machine_learning_tpu.parallel.trial_map import run_trials
 
 
@@ -57,3 +58,31 @@ def test_static_bucketing_separates_compiles():
     params = [{"C": 1.0, "fit_intercept": True}, {"C": 1.0, "fit_intercept": False}]
     out = run_trials(kernel, data, plan, params)
     assert len(out.trial_metrics) == 2
+
+
+def test_host_fast_path_used_for_tiny_buckets(monkeypatch):
+    """Tiny buckets of kernels with an analytical cost estimate run on the
+    host CPU backend (placement decision); scores must match the device
+    path. On a CPU-default backend the flag is moot — this exercises the
+    decision logic and the result plumbing."""
+    import jax
+
+    from cs230_distributed_machine_learning_tpu.models.base import TrialData
+    from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+    from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+
+    X = np.random.RandomState(0).randn(120, 5).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.int32)
+    data = TrialData(X=X, y=y, n_classes=2)
+    plan = build_split_plan(y, task="classification", n_folds=3)
+    kernel = get_kernel("LogisticRegression")
+    static = kernel.resolve_static({"fit_intercept": True, "penalty": "l2"},
+                                   120, 5, 2)
+    static["_n_classes"] = 2
+    # the analytical estimate puts an iris-scale bucket under the host cap
+    assert kernel.macs_estimate(120, 5, static) * 4 * 8 < trial_map._HOST_EXEC_MACS
+    out = trial_map.run_trials(kernel, data, plan,
+                               [{"C": c} for c in (0.1, 1.0, 10.0)])
+    assert len(out.trial_metrics) == 3
+    for m in out.trial_metrics:
+        assert 0.5 <= m["mean_cv_score"] <= 1.0
