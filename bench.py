@@ -73,15 +73,14 @@ def main() -> None:
     cache = manager._coordinator.cache
     data = cache.get(dataset, "classification")
     X, y = np.asarray(data.X), np.asarray(data.y)
-    # stratified subsample of the ACTUAL trial population: sort the full
-    # n_iter draw by C and take evenly spaced quantile positions, so slow
-    # (small-C, slow-converging) and fast trials are both represented
+    # stratified subsample of the ACTUAL trial population: slow (small-C,
+    # slow-converging) and fast trials both represented
+    from cs230_distributed_machine_learning_tpu.utils.flops import stratified_by
+
     population = list(
         ParameterSampler(param_distributions, n_iter=N_TRIALS, random_state=0)
     )
-    by_c = sorted(population, key=lambda p: p["C"])
-    pos = np.linspace(0, len(by_c) - 1, min(SK_TRIALS, len(by_c))).round().astype(int)
-    sampled = [by_c[i] for i in pos]
+    sampled = stratified_by(population, lambda p: p["C"], SK_TRIALS)
     per_trial_times = []
     for params in sampled:
         model = LogisticRegression(max_iter=200, **params)

@@ -119,15 +119,18 @@ def main() -> None:
     cache = manager._coordinator.cache
     report = []
 
-    def _flops_mfu(model_name, statics, n, d, n_classes, n_trials, steady_s):
-        """Model-analytical FLOPs + achieved MFU for a config (None when the
-        kernel has no estimate or the run was host-executed)."""
+    def _config_flops(model_name, statics, n, d, n_classes, n_trials):
+        """Model-analytical FLOPs for a config slice (None when the kernel
+        has no estimate)."""
         kernel = get_kernel(model_name)
         static = kernel.resolve_static(dict(statics), n, d, n_classes)
         static["_n_classes"] = n_classes
         if hasattr(kernel, "bucket_static"):
             static = kernel.bucket_static(static, [statics])
-        fl = analytical_flops(kernel, static, n, d, 6, n_trials)
+        return analytical_flops(kernel, static, n, d, 6, n_trials)
+
+    def _flops_mfu(model_name, statics, n, d, n_classes, n_trials, steady_s):
+        fl = _config_flops(model_name, statics, n, d, n_classes, n_trials)
         return fl, mfu(fl, steady_s)
 
     def record(name, sk_time, sk_extrapolated, our_time, steady_time, n_trials,
@@ -195,12 +198,14 @@ def main() -> None:
     dists = {"C": loguniform(1e-3, 1e2)}
     # stratified-by-C subsample of the actual 1000-trial population (cost
     # varies strongly with C; 2 random draws made the extrapolation soft)
-    population = sorted(
-        ParameterSampler(dists, n_iter=1000, random_state=0), key=lambda p: p["C"]
+    from cs230_distributed_machine_learning_tpu.utils.flops import stratified_by
+
+    sampled3 = stratified_by(
+        list(ParameterSampler(dists, n_iter=1000, random_state=0)),
+        lambda p: p["C"], 8,
     )
-    pos = np.linspace(0, len(population) - 1, 8).round().astype(int)
     sk_times, sk_cvs = [], []
-    for combo in (population[i] for i in pos):
+    for combo in sampled3:
         t0 = time.time()
         sk_cvs.append(_sk_trial(LogisticRegression(max_iter=200, **combo), Xc, yc))
         sk_times.append(time.time() - t0)
@@ -243,14 +248,12 @@ def main() -> None:
     )
     # sum per-combo FLOPs (the grid halves on n_estimators: 2x50 + 2x100)
     fl = sum(
-        _flops_mfu("GradientBoostingRegressor",
-                   {"n_estimators": ne, "random_state": 0},
-                   len(Xt), Xt.shape[1], 0, 2, steady)[0]
+        _config_flops("GradientBoostingRegressor",
+                      {"n_estimators": ne, "random_state": 0},
+                      len(Xt), Xt.shape[1], 0, 2)
         for ne in (50, 100)
     )
-    from cs230_distributed_machine_learning_tpu.utils.flops import mfu as _mfu
-
-    util = _mfu(fl, steady)
+    util = mfu(fl, steady)
     record("4. GBRegressor GridSearchCV titanic (yaml)", sk, False, ours, steady, n,
            flops=fl, util=util, cv_ours=best["mean_cv_score"], cv_sk=max(sk_cvs))
 

@@ -335,10 +335,13 @@ class FetchingDatasetCache(DatasetCache):
     becomes reachable from every remote agent, fetched once and then served
     from the local staged layout.
 
-    Resolution order: local *preprocessed* copy -> coordinator (which
-    returns ITS best: preprocessed over raw — so an agent holding only a
-    raw builtin still learns about a coordinator-side preprocess) -> local
-    raw/builtin staging.
+    Resolution per lookup: local *preprocessed* copy -> cheap coordinator
+    probe (``?probe=1``, JSON kind only) -> download when the coordinator
+    holds something better than what's local (preprocessed beats raw) ->
+    local raw/builtin staging. The probe runs on every DatasetCache miss
+    (a handful per process), so a preprocess staged on the coordinator
+    AFTER an agent's first raw fetch is picked up without a restart —
+    nothing is negative-cached.
     """
 
     def __init__(self, coordinator_url: str, root: Optional[str] = None,
@@ -346,17 +349,38 @@ class FetchingDatasetCache(DatasetCache):
         super().__init__(root=root)
         self._url = coordinator_url.rstrip("/")
         self._timeout_s = timeout_s
-        self._fetched: set = set()
 
     def resolve_csv(self, dataset_id: str) -> str:
         local_pre = find_csv(dataset_id, preprocessed=True, root=self._root)
         if local_pre is not None:
             return local_pre
-        if dataset_id not in self._fetched:
+        remote_kind = self._probe(dataset_id)
+        if remote_kind is not None:
+            local_raw = find_csv(dataset_id, root=self._root)
+            if remote_kind == "raw" and local_raw is not None:
+                return local_raw
             path = self._fetch(dataset_id)
             if path is not None:
                 return path
         return super().resolve_csv(dataset_id)
+
+    def _probe(self, dataset_id: str) -> Optional[str]:
+        """Coordinator's staged kind for the dataset ('preprocessed'/'raw')
+        or None when absent/unreachable."""
+        import requests
+
+        try:
+            resp = requests.get(
+                f"{self._url}/dataset/{dataset_id}",
+                params={"probe": "1"},
+                timeout=min(self._timeout_s, 15.0),
+            )
+            if resp.status_code == 404:
+                return None
+            resp.raise_for_status()
+            return resp.json().get("kind", "raw")
+        except Exception:  # noqa: BLE001
+            return None
 
     def _fetch(self, dataset_id: str) -> Optional[str]:
         import requests
@@ -369,9 +393,6 @@ class FetchingDatasetCache(DatasetCache):
                 f"{self._url}/dataset/{dataset_id}", timeout=self._timeout_s
             )
             if resp.status_code == 404:
-                # NOT negative-cached: the dataset may be staged on the
-                # coordinator later (download_data then resubmit) and must
-                # become fetchable without an agent restart
                 return None
             resp.raise_for_status()
         except Exception:  # noqa: BLE001
@@ -391,7 +412,6 @@ class FetchingDatasetCache(DatasetCache):
         with open(tmp, "wb") as f:
             f.write(resp.content)
         os.replace(tmp, out)
-        self._fetched.add(dataset_id)
         logger.info("Fetched dataset %s (%s, %d bytes) from coordinator",
                     dataset_id, kind, len(resp.content))
         return out

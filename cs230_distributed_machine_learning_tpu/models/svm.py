@@ -21,22 +21,39 @@ vmap), votes aggregated with sklearn's tie-breaking (first max).
 
 Hypers: ``C`` (traced), ``epsilon`` for SVR (traced), ``gamma`` traced when
 numeric; "scale"/"auto" resolve per-fit from the masked data like sklearn.
-``kernel`` ("rbf" | "linear" | "poly") is static. Gram matrices are [n,n]
-— fits are gated to moderate n (SVMs at Covertype scale are equally
-intractable for the reference's libsvm workers).
+``kernel`` ("rbf" | "linear" | "poly") is static.
+
+Above ``_MAX_N`` samples the exact [n, n] Gram matrix is dropped for a
+**Nyström primal** solve (round-1 verdict #5 — the 30k gate previously
+errored at Covertype scale): m landmark rows give features
+``Z = K(X, L) @ K_LL^{-1/2}`` (one [n,m,d] matmul + a [m,m] eigh), and each
+machine solves the primal squared-hinge (SVC) / huberized
+epsilon-insensitive (SVR) objective on Z with Nesterov descent — every
+iteration one [n,m]x[m] matvec, batched across OvO pairs/vmapped trials on
+the MXU. Documented tolerance: the rank-m kernel approximation makes scores
+match exact-SVM to a few points of CV (the gated test compares against
+sklearn on a subsample); the reference's libsvm workers could not complete
+these fits at all (SMO is O(n^2..3) — Covertype SVC would run for days).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .base import ModelKernel
 
 _PG_STEPS = 600
 _MAX_N = 30_000
+_NYSTROM_STEPS = 300
+
+
+def _nystrom_m(n: int) -> int:
+    return int(os.environ.get("CS230_SVM_NYSTROM_M", 2048))
 
 
 def _gram(X1, X2, kernel: str, gamma, degree, coef0):
@@ -51,6 +68,33 @@ def _gram(X1, X2, kernel: str, gamma, degree, coef0):
         - 2.0 * (X1 @ X2.T)
     )
     return jnp.exp(-gamma * jnp.maximum(d2, 0.0))
+
+
+def _nystrom_features(X, landmarks, kernel: str, gamma, degree, coef0):
+    """Z [n, m] with Z Z' ~ K(X, X): K(X, L) @ K(L, L)^{-1/2} (psd sqrt via
+    eigh with a floor on the spectrum)."""
+    KL = _gram(X, landmarks, kernel, gamma, degree, coef0)
+    KLL = _gram(landmarks, landmarks, kernel, gamma, degree, coef0)
+    vals, vecs = jnp.linalg.eigh(KLL)
+    inv_sqrt = vecs * jax.lax.rsqrt(jnp.maximum(vals, 1e-6))[None, :]
+    return KL @ inv_sqrt, inv_sqrt
+
+
+def _nesterov_primal(Z, grad_fn, L_est, steps):
+    """min_w f(w) by Nesterov descent with an analytic Lipschitz bound."""
+
+    def body(carry, t):
+        w, w_prev = carry
+        v = w + (t / (t + 3.0)) * (w - w_prev)
+        g = grad_fn(v)
+        w_new = v - g / L_est
+        return (w_new, w), None
+
+    w0 = jnp.zeros((Z.shape[1],), jnp.float32)
+    (w, _), _ = jax.lax.scan(
+        body, (w0, w0), jnp.arange(steps, dtype=jnp.float32)
+    )
+    return w
 
 
 def _project_box_ascent(Q, lin, lo, hi, steps=_PG_STEPS):
@@ -84,8 +128,6 @@ class SVCKernel(ModelKernel):
     static_defaults = {"kernel": "rbf", "gamma": "scale", "degree": 3, "coef0": 0.0}
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
-        if n > _MAX_N:
-            raise ValueError(f"SVC: n={n} exceeds the {_MAX_N}-sample Gram-matrix gate")
         if static.get("kernel") not in ("rbf", "linear", "poly"):
             raise ValueError(f"SVC: unsupported kernel {static.get('kernel')!r}")
         g = static.get("gamma", "scale")
@@ -93,7 +135,33 @@ class SVCKernel(ModelKernel):
             static = {**static, "_gamma_mode": "numeric", "_gamma_value": float(g)}
         else:
             static = {**static, "_gamma_mode": g}
+        if n > _MAX_N:
+            # beyond the exact-Gram gate: Nyström primal (module docstring)
+            static = {**static, "_nystrom": True, "_m": min(_nystrom_m(n), n)}
         return static
+
+    # ---- shared Nyström-primal machinery (SVC + SVR) ----
+
+    def _nystrom_Z(self, X, gamma, static):
+        n = X.shape[0]
+        m = int(static["_m"])
+        idx = np.random.RandomState(17).choice(n, m, replace=False)
+        landmarks = X[jnp.asarray(idx)]
+        Z, inv_sqrt = _nystrom_features(
+            X, landmarks, static["kernel"], gamma,
+            static.get("degree", 3), static.get("coef0", 0.0),
+        )
+        Z = jnp.concatenate([Z, jnp.ones((n, 1), jnp.float32)], axis=1)
+        # Lipschitz ingredient: lambda_max(Z'Z) by power iteration
+        v = jnp.ones((Z.shape[1],), jnp.float32)
+
+        def power(v, _):
+            u = Z.T @ (Z @ v)
+            return u / jnp.maximum(jnp.linalg.norm(u), 1e-12), None
+
+        v, _ = jax.lax.scan(power, v, None, length=20)
+        lam_max = jnp.maximum(jnp.dot(v, Z.T @ (Z @ v)), 1e-6)
+        return Z, landmarks, inv_sqrt, lam_max
 
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
         X = X.astype(jnp.float32)
@@ -101,6 +169,8 @@ class SVCKernel(ModelKernel):
         c = max(int(static["_n_classes"]), 2)
         C = jnp.asarray(hyper["C"], jnp.float32)
         gamma = self._gamma(X, w, static)
+        if static.get("_nystrom"):
+            return self._fit_nystrom(X, y, w, C, gamma, static, c)
         K = _gram(X, X, static["kernel"], gamma, static.get("degree", 3), static.get("coef0", 0.0))
         K = K + 1.0  # bias via constant feature in feature space
 
@@ -121,17 +191,53 @@ class SVCKernel(ModelKernel):
         coefs = jax.vmap(fit_pair)(pa, pb)  # [n_pairs, n]
         return {"X": X, "dual": coefs, "gamma": gamma, "pairs_a": pa, "pairs_b": pb}
 
+    def _fit_nystrom(self, X, y, w, C, gamma, static, c):
+        """Primal squared-hinge OvO machines on Nyström features."""
+        Z, landmarks, inv_sqrt, lam_max = self._nystrom_Z(X, gamma, static)
+        pairs = [(i, j) for i in range(c) for j in range(i + 1, c)]
+        pa = jnp.asarray([p[0] for p in pairs])
+        pb = jnp.asarray([p[1] for p in pairs])
+        L_est = 1.0 + 2.0 * C * lam_max
+
+        def fit_pair(cls_a, cls_b):
+            s = (((y == cls_a) | (y == cls_b)) & (w > 0)).astype(jnp.float32)
+            t = jnp.where(y == cls_a, 1.0, -1.0)
+
+            def grad(wv):
+                margin = jnp.maximum(0.0, 1.0 - t * (Z @ wv))
+                return wv - 2.0 * C * (Z.T @ (s * t * margin))
+
+            return _nesterov_primal(Z, grad, L_est, _NYSTROM_STEPS)
+
+        W = jax.vmap(fit_pair)(pa, pb)  # [n_pairs, m+1]
+        return {
+            "W": W,
+            "landmarks": landmarks,
+            "inv_sqrt": inv_sqrt,
+            "gamma": gamma,
+            "pairs_a": pa,
+            "pairs_b": pb,
+        }
+
     def predict(self, params, X, static: Dict[str, Any]):
         c = max(int(static["_n_classes"]), 2)
-        Kq = _gram(
-            X.astype(jnp.float32),
-            params["X"],
-            static["kernel"],
-            params["gamma"],
-            static.get("degree", 3),
-            static.get("coef0", 0.0),
-        ) + 1.0
-        dec = Kq @ params["dual"].T  # [nq, n_pairs], >0 votes class pairs_a
+        if "W" in params:
+            Zq = _gram(
+                X.astype(jnp.float32), params["landmarks"], static["kernel"],
+                params["gamma"], static.get("degree", 3), static.get("coef0", 0.0),
+            ) @ params["inv_sqrt"]
+            Zq = jnp.concatenate([Zq, jnp.ones((X.shape[0], 1), jnp.float32)], 1)
+            dec = Zq @ params["W"].T  # [nq, n_pairs]
+        else:
+            Kq = _gram(
+                X.astype(jnp.float32),
+                params["X"],
+                static["kernel"],
+                params["gamma"],
+                static.get("degree", 3),
+                static.get("coef0", 0.0),
+            ) + 1.0
+            dec = Kq @ params["dual"].T  # [nq, n_pairs], >0 votes class pairs_a
         vote_a = (dec > 0).astype(jnp.float32)
         votes = jnp.zeros((X.shape[0], c), jnp.float32)
         votes = votes.at[:, params["pairs_a"]].add(vote_a)
@@ -150,6 +256,9 @@ class SVCKernel(ModelKernel):
         return 1.0 / jnp.maximum(X.shape[1] * var, 1e-12)
 
     def memory_estimate_mb(self, n, d, static):
+        if static.get("_nystrom"):
+            m = int(static.get("_m", 2048)) + 1
+            return max(1.0, 4.0 * (2.0 * n * m + n * d) / 1e6)
         return max(1.0, 4.0 * (n * n * 2 + n * d) / 1e6)
 
 
@@ -161,6 +270,7 @@ class SVRKernel(ModelKernel):
 
     resolve_static = SVCKernel.resolve_static
     _gamma = SVCKernel._gamma
+    _nystrom_Z = SVCKernel._nystrom_Z
     memory_estimate_mb = SVCKernel.memory_estimate_mb
 
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
@@ -170,6 +280,8 @@ class SVRKernel(ModelKernel):
         C = jnp.asarray(hyper["C"], jnp.float32)
         eps = jnp.asarray(hyper["epsilon"], jnp.float32)
         gamma = self._gamma(X, w, static)
+        if static.get("_nystrom"):
+            return self._fit_nystrom(X, y, w, C, eps, gamma, static)
         K = _gram(X, X, static["kernel"], gamma, static.get("degree", 3), static.get("coef0", 0.0)) + 1.0
         s = (w > 0).astype(jnp.float32)
         n = K.shape[0]
@@ -183,7 +295,29 @@ class SVRKernel(ModelKernel):
         beta = (a[:n] - a[n:]) * s
         return {"X": X, "dual": beta, "gamma": gamma}
 
+    def _fit_nystrom(self, X, y, w, C, eps, gamma, static):
+        """Primal huberized epsilon-insensitive regression on Nyström
+        features: l(r) = max(0, |r| - eps)^2."""
+        Z, landmarks, inv_sqrt, lam_max = self._nystrom_Z(X, gamma, static)
+        s = (w > 0).astype(jnp.float32)
+        L_est = 1.0 + 2.0 * C * lam_max
+
+        def grad(wv):
+            r = Z @ wv - y
+            dl = 2.0 * jnp.sign(r) * jnp.maximum(jnp.abs(r) - eps, 0.0)
+            return wv + C * (Z.T @ (s * dl))
+
+        wv = _nesterov_primal(Z, grad, L_est, _NYSTROM_STEPS)
+        return {"W": wv, "landmarks": landmarks, "inv_sqrt": inv_sqrt, "gamma": gamma}
+
     def predict(self, params, X, static: Dict[str, Any]):
+        if "W" in params:
+            Zq = _gram(
+                X.astype(jnp.float32), params["landmarks"], static["kernel"],
+                params["gamma"], static.get("degree", 3), static.get("coef0", 0.0),
+            ) @ params["inv_sqrt"]
+            Zq = jnp.concatenate([Zq, jnp.ones((X.shape[0], 1), jnp.float32)], 1)
+            return Zq @ params["W"]
         Kq = _gram(
             X.astype(jnp.float32),
             params["X"],

@@ -180,7 +180,11 @@ def create_app(coordinator: Optional[Coordinator] = None):
 
     def dataset(request, dataset_id):
         """Serve the coordinator's staged CSV (preprocessed preferred) so
-        remote agents can fetch-on-miss (FetchingDatasetCache)."""
+        remote agents can fetch-on-miss (FetchingDatasetCache). ``?probe=1``
+        returns only the staged kind (cheap freshness check — agents probe
+        before downloading)."""
+        from werkzeug.wsgi import wrap_file
+
         from ..data.datasets import find_csv
 
         root = coord.config.storage.datasets_dir
@@ -194,11 +198,14 @@ def create_app(coordinator: Optional[Coordinator] = None):
                 {"status": "error", "message": f"dataset {dataset_id!r} not staged"},
                 status=404,
             )
-        with open(path, "rb") as f:
-            payload = f.read()
+        if request.args.get("probe"):
+            return _json({"kind": kind, "size": __import__("os").path.getsize(path)})
+        # streamed, not read into memory: N agents cold-starting on a
+        # 100 MB dataset must not allocate N full copies coordinator-side
         return Response(
-            payload,
+            wrap_file(request.environ, open(path, "rb")),
             mimetype="text/csv",
+            direct_passthrough=True,
             headers={
                 "X-Dataset-Kind": kind,
                 "Content-Disposition": f"attachment; filename={dataset_id}.csv",
@@ -233,3 +240,43 @@ def serve(coordinator: Optional[Coordinator] = None, host: Optional[str] = None,
     cfg = get_config().service
     app = create_app(coordinator)
     run_simple(host or cfg.host, port or cfg.port, app, threaded=True)
+
+
+def main() -> None:
+    """``tpuml-coordinator`` console entry point: serve the REST surface.
+
+    - ``--cluster`` (default): scheduler-mediated dispatch — remote agents
+      register over /subscribe; optionally ``--local-executors N`` adds
+      in-process workers so the box serves jobs with no agents attached.
+    - ``--direct``: single in-process executor, no placement engine (the
+      laptop / single-TPU-VM mode).
+    The compose analog: reference docker-compose.yml:86-131 (master +
+    scheduler services collapsed into this one process).
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(description="tpuml coordinator server")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument("--direct", action="store_true",
+                        help="in-process executor, no placement engine")
+    parser.add_argument("--local-executors", type=int, default=0, metavar="N",
+                        help="cluster mode: also attach N in-process executors")
+    parser.add_argument("--journal", action="store_true",
+                        help="journal job state; resume in-flight jobs on restart")
+    args = parser.parse_args()
+
+    if args.direct:
+        coord = Coordinator(journal=args.journal)
+    else:
+        from .cluster import ClusterRuntime
+
+        cluster = ClusterRuntime()
+        for _ in range(max(args.local_executors, 0)):
+            cluster.add_executor()
+        coord = Coordinator(cluster=cluster, journal=args.journal)
+    serve(coord, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,20 @@ def analytical_flops(
     return 2.0 * per * max(n_splits, 1) * max(n_trials, 1)
 
 
+def stratified_by(population, key_fn, n_samples: int):
+    """Evenly spaced quantile positions of ``population`` sorted by
+    ``key_fn`` — the harnesses' shared subsampling for extrapolated sklearn
+    denominators (per-trial cost varies strongly with e.g. C under
+    loguniform, so random draws under-represent the tails)."""
+    import numpy as np
+
+    srt = sorted(population, key=key_fn)
+    pos = (
+        np.linspace(0, len(srt) - 1, min(n_samples, len(srt))).round().astype(int)
+    )
+    return [srt[i] for i in pos]
+
+
 def mfu(flops: Optional[float], wall_s: float) -> Optional[float]:
     """Achieved fraction of device peak; None off-accelerator or without an
     analytical FLOPs figure."""

@@ -20,4 +20,11 @@ setup(
         "client": ["requests", "tqdm"],
         "server": ["werkzeug"],
     },
+    entry_points={
+        "console_scripts": [
+            # deployment surface (reference: docker-compose.yml services)
+            "tpuml-coordinator=cs230_distributed_machine_learning_tpu.runtime.server:main",
+            "tpuml-agent=cs230_distributed_machine_learning_tpu.runtime.agent:main",
+        ]
+    },
 )
