@@ -1,0 +1,102 @@
+"""Profile the GradientBoosting stage loop at Covertype scale on one chip.
+
+VERDICT.md #10 asks for (splits x classes x nodes) batched into one
+histogram contraction. This harness measures where a boosting stage's time
+actually goes so the fix is driven by data, not the hypothesis: it times
+the chunked trial path (the production route for GB at this scale) and a
+bare stage loop, and reports achieved MACs/s vs the kernel's own
+macs_estimate.
+
+Run: python benchmarks/gb_profile.py [--frac 0.25] [--stages 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frac", type=float, default=0.25)
+    ap.add_argument("--stages", type=int, default=20)
+    ap.add_argument("--trials", type=int, default=4)
+    ap.add_argument("--splits", type=int, default=6)
+    ap.add_argument("--model", default="GradientBoostingClassifier")
+    args = ap.parse_args()
+
+    from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+    from cs230_distributed_machine_learning_tpu.models.base import TrialData
+    from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+
+    task = "classification" if args.model.endswith("Classifier") else "regression"
+    full = Coordinator().cache.get("covertype", task)
+    X_full, y_full = np.asarray(full.X), np.asarray(full.y)
+    n = int(len(X_full) * args.frac)
+    rng = np.random.default_rng(0)
+    sel = rng.permutation(len(X_full))[:n]
+    X, y = X_full[sel], y_full[sel]
+    data = TrialData(X=X, y=y, n_classes=full.n_classes)
+    plan = build_split_plan(y, task=task, n_folds=args.splits - 1, test_size=0.2)
+
+    kernel = get_kernel(args.model)
+    params = {"n_estimators": args.stages, "learning_rate": 0.1,
+              "random_state": 0}
+    static_key, hyper = kernel.canonicalize(params)
+    static = kernel.static_from_key(static_key)
+    static = kernel.resolve_static(static, n, X.shape[1], data.n_classes)
+    static["_n_classes"] = data.n_classes
+
+    macs_total = (
+        kernel.macs_estimate(n, X.shape[1], static)
+        * args.splits * args.trials
+    )
+
+    # --- production path: chunked trial engine ------------------------------
+    from cs230_distributed_machine_learning_tpu.parallel.trial_map import run_trials
+
+    t0 = time.perf_counter()
+    res = run_trials(kernel, data, plan, [params] * args.trials)
+    wall = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = run_trials(kernel, data, plan, [params] * args.trials)
+    steady = time.perf_counter() - t0
+    print(f"[trial-engine] cold={wall:.2f}s steady={steady:.2f}s "
+          f"dispatches={res.n_dispatches}")
+    print(f"[trial-engine] steady {macs_total / steady / 1e12:.3f} TMAC/s "
+          f"({2 * macs_total / steady / 1e12:.2f} TFLOP/s) over "
+          f"{macs_total:.3e} est MACs")
+
+    # --- bare stage loop: one (trial, split), isolates the stage kernel -----
+    X_prep = jax.tree_util.tree_map(
+        jnp.asarray, kernel.prepare_data(np.asarray(data.X), static))
+    yd = jnp.asarray(data.y)
+    w = jnp.asarray(plan.train_w[0])
+    hyper_arg = {k: jnp.asarray(v, jnp.float32) for k, v in hyper.items()}
+
+    @jax.jit
+    def fit_bare(X, y, w, h):
+        return kernel.fit(X, y, w, h, static)
+
+    out = jax.block_until_ready(fit_bare(X_prep, yd, w, hyper_arg))
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fit_bare(X_prep, yd, w, hyper_arg))
+    dt = time.perf_counter() - t0
+    per_stage = dt / args.stages
+    macs_one = kernel.macs_estimate(n, X.shape[1], static)
+    print(f"[bare 1x1] {dt:.3f}s total, {per_stage * 1e3:.1f} ms/stage, "
+          f"{macs_one / dt / 1e12:.3f} TMAC/s")
+
+
+if __name__ == "__main__":
+    main()

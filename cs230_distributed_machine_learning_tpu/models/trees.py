@@ -195,7 +195,7 @@ class _TreeBase(ModelKernel):
     def _tree_predict(self, xq, tree, static):
         if static.get("_deep"):
             return predict_tree_deep(xq, tree, static["_levels"])
-        return predict_tree(xq, tree, static["_depth"])
+        return predict_tree(xq, tree, static["_depth"], static["_n_bins"])
 
     # trial-engine hook: bin once per bucket, share across trials/splits
     def prepare_data(self, X: np.ndarray, static: Dict[str, Any]):
@@ -582,7 +582,7 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
         trees = jax.vmap(per_class, in_axes=(1, 1, 0))(G, H, keys)
 
         def upd(tree):
-            return predict_tree(xb, tree, depth)[:, 0]
+            return predict_tree(xb, tree, depth, n_bins)[:, 0]
 
         delta = jax.vmap(upd)(trees).T  # [n, kdim]
         if c > 2:
@@ -612,7 +612,7 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
 
     def predict(self, params, X, static: Dict[str, Any]):
         c = max(int(static["_n_classes"]), 2)
-        depth = static["_depth"]
+        depth, nbq = static["_depth"], static["_n_bins"]
         xq = self._query_bins(params, X, static)
         prior = params["prior"]
         lr = params["lr"]
@@ -620,7 +620,7 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
 
         def per_stage(F, stage_trees):
             def upd(tree):
-                return predict_tree(xq, tree, depth)[:, 0]
+                return predict_tree(xq, tree, depth, nbq)[:, 0]
 
             delta = jax.vmap(upd)(stage_trees).T
             if c > 2:
@@ -668,7 +668,7 @@ class GradientBoostingRegressorKernel(_GradientBoostingBase):
             max_features=static["_mf"] if static["_mf"] < xb.shape[1] else None,
             key=feat_key,
         )
-        F = F + lr * predict_tree(xb, tree, depth)[:, 0]
+        F = F + lr * predict_tree(xb, tree, depth, n_bins)[:, 0]
         return F, tree
 
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
@@ -691,12 +691,12 @@ class GradientBoostingRegressorKernel(_GradientBoostingBase):
         return self.assemble_artifact(trees, X, hyper, static, y, w)
 
     def predict(self, params, X, static: Dict[str, Any]):
-        depth = static["_depth"]
+        depth, nbq = static["_depth"], static["_n_bins"]
         xq = self._query_bins(params, X, static)
         lr = params["lr"]
 
         def per_stage(F, tree):
-            return F + lr * predict_tree(xq, tree, depth)[:, 0], None
+            return F + lr * predict_tree(xq, tree, depth, nbq)[:, 0], None
 
         F0 = jnp.full((xq.shape[0],), params["prior"])
         F, _ = jax.lax.scan(per_stage, F0, params["trees"])

@@ -118,8 +118,14 @@ def _split_gain(H, k: int, n_bins: int, min_samples_leaf: float):
     """
     Sh = H[..., :k]
     Ch = jnp.maximum(H[..., k], 0.0)
-    Scum = jnp.cumsum(Sh, axis=2)  # left stats for split at bin b
-    Ccum = jnp.cumsum(Ch, axis=2)
+    # prefix sums over bins as a triangular-ones contraction: jnp.cumsum on
+    # the [.., n_bins, ..] axis lowers to a slow sequential/log-pass TPU
+    # fusion (profiled ~30 ms per stage at production batch); the matmul is
+    # one MXU pass over a [n_bins, n_bins] mask
+    tri = jnp.tril(jnp.ones((n_bins, n_bins), jnp.float32))  # tri[b', b<=b']
+    hp = jax.lax.Precision.HIGHEST
+    Scum = jnp.einsum("mdbk,cb->mdck", Sh, tri, precision=hp)
+    Ccum = jnp.einsum("mdb,cb->mdc", Ch, tri, precision=hp)
     S_tot = Scum[:, :, -1:, :]
     C_tot = Ccum[:, :, -1:]
     Sr = S_tot - Scum
@@ -143,6 +149,67 @@ def _pick_best(gain, n_bins: int):
     bf = (best // n_bins).astype(jnp.int32)
     bb = (best % n_bins).astype(jnp.int32)
     return bg, bf, bb
+
+
+#: largest per-level node count handled by the gather-free routing /
+#: leaf-aggregation forms below. Per-sample gathers from tiny tables
+#: (``tab[node]``) and tiny-segment scatters (``segment_sum``) both lower to
+#: serialized TPU kernels — profiled at ~45 ms per gather per level and
+#: ~46 ms per segment_sum at a production trial batch (168 lanes x 29k
+#: rows), which made them >95% of a GradientBoosting stage's device time.
+#: The one-hot matmul / compare-reduce forms are MXU/VPU passes (~3-5 ms).
+#: Past this node count the O(n*m) masked forms lose to the O(n) gather.
+_LOOKUP_M = 256
+
+
+def _col_select(xb, feats, n_bins: int):
+    """[n, m] matrix whose column j is ``xb[:, feats[j]]`` — a dynamic
+    column gather expressed as a one-hot contraction. Exact: bin codes are
+    integers < 256, representable in bf16, and the one-hot picks a single
+    term per output, so f32 accumulation reproduces the codes bit-exactly.
+    """
+    d = xb.shape[1]
+    if n_bins > 256:  # codes could exceed bf16's exact-integer range
+        oh = jax.nn.one_hot(feats, d, dtype=jnp.float32)
+        return jnp.dot(
+            xb.astype(jnp.float32), oh.T, precision=jax.lax.Precision.HIGHEST
+        )
+    oh = jax.nn.one_hot(feats, d, dtype=jnp.bfloat16)
+    return jnp.dot(
+        xb.astype(jnp.bfloat16), oh.T, preferred_element_type=jnp.float32
+    )
+
+
+def _route_left(xb, local, bf, bb, n_bins: int):
+    """Per-sample go-left decision for one level, gather-free: compare every
+    node's split column against its bin and mask-reduce by the sample's node
+    id, instead of ``xb[arange(n), bf[local]] <= bb[local]``."""
+    m = bf.shape[0]
+    cols = _col_select(xb, bf, n_bins)                      # [n, m] f32
+    le = cols <= bb[None, :].astype(cols.dtype)             # [n, m]
+    oh = local[:, None] == jnp.arange(m, dtype=local.dtype)
+    return jnp.any(oh & le, axis=1)
+
+
+def _leaf_sums(leaf_local, SC, n_leaves: int):
+    """``one_hot(leaf).T @ SC`` — scatter-free segment_sum over tree leaves.
+    Exact one-hot selection with f32 accumulation; summation order differs
+    from segment_sum only in float addition order (~1 ulp)."""
+    oh = jax.nn.one_hot(leaf_local, n_leaves, dtype=SC.dtype)
+    return jax.lax.dot_general(
+        oh,
+        SC,
+        (((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _leaf_select(leaf_local, V, n_leaves: int):
+    """``one_hot(leaf) @ V`` — gather-free ``V[leaf]`` for leaf-value
+    lookup. Exact: the one-hot picks a single f32 row per sample."""
+    oh = jax.nn.one_hot(leaf_local, n_leaves, dtype=V.dtype)
+    return jnp.dot(oh, V, precision=jax.lax.Precision.HIGHEST)
 
 
 def _node_feature_mask(gain, node_ids, key, max_features: Optional[int], d: int):
@@ -237,15 +304,22 @@ def build_tree(
         split_feat = jax.lax.dynamic_update_slice(split_feat, bf, (base,))
         split_bin = jax.lax.dynamic_update_slice(split_bin, bb, (base,))
 
-        f_i = split_feat[node]
-        b_i = split_bin[node]
-        go_left = xb[jnp.arange(n), f_i] <= b_i
+        if n_nodes <= _LOOKUP_M:
+            go_left = _route_left(xb, local, bf, bb, n_bins)
+        else:
+            f_i = split_feat[node]
+            b_i = split_bin[node]
+            go_left = xb[jnp.arange(n), f_i] <= b_i
         node = 2 * node + 1 + jnp.where(go_left, 0, 1)
 
     leaf_local = node - n_internal
     n_leaves = 2**depth
-    Sl = jax.ops.segment_sum(S, leaf_local, num_segments=n_leaves)
-    Cl = jax.ops.segment_sum(C, leaf_local, num_segments=n_leaves)
+    if n_leaves <= _LOOKUP_M:
+        SCl = _leaf_sums(leaf_local, SC, n_leaves)
+        Sl, Cl = SCl[:, :k], SCl[:, k]
+    else:
+        Sl = jax.ops.segment_sum(S, leaf_local, num_segments=n_leaves)
+        Cl = jax.ops.segment_sum(C, leaf_local, num_segments=n_leaves)
     leaf_val = Sl / jnp.maximum(Cl, _EPS)[:, None]
     return {
         "split_feat": split_feat,
@@ -404,19 +478,30 @@ def predict_tree_deep(xb, tree, levels: int):
     return tree["leaf_val"][leaf]
 
 
-@partial(jax.jit, static_argnames=("depth",))
-def _route(xb, split_feat, split_bin, depth: int):
+@partial(jax.jit, static_argnames=("depth", "n_bins"))
+def _route(xb, split_feat, split_bin, depth: int, n_bins: int = 0):
     n = xb.shape[0]
     node = jnp.zeros((n,), jnp.int32)
-    for _ in range(depth):
-        f_i = split_feat[node]
-        b_i = split_bin[node]
-        go_left = xb[jnp.arange(n), f_i] <= b_i
+    for level in range(depth):
+        base, m = 2**level - 1, 2**level
+        if m <= _LOOKUP_M:
+            # gather-free: this level's split records are a static slice
+            bf = jax.lax.slice(split_feat, (base,), (base + m,))
+            bb = jax.lax.slice(split_bin, (base,), (base + m,))
+            go_left = _route_left(xb, node - base, bf, bb, n_bins or 1 << 30)
+        else:
+            f_i = split_feat[node]
+            b_i = split_bin[node]
+            go_left = xb[jnp.arange(n), f_i] <= b_i
         node = 2 * node + 1 + jnp.where(go_left, 0, 1)
     return node - (2**depth - 1)
 
 
-def predict_tree(xb, tree, depth: int):
-    """Leaf values for each row of binned query data."""
-    leaf = _route(xb, tree["split_feat"], tree["split_bin"], depth)
+def predict_tree(xb, tree, depth: int, n_bins: int = 0):
+    """Leaf values for each row of binned query data. ``n_bins`` (when
+    known) lets the gather-free router use the fast bf16 column select."""
+    leaf = _route(xb, tree["split_feat"], tree["split_bin"], depth, n_bins)
+    n_leaves = 2**depth
+    if n_leaves <= _LOOKUP_M:
+        return _leaf_select(leaf, tree["leaf_val"], n_leaves)
     return tree["leaf_val"][leaf]
