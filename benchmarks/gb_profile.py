@@ -97,6 +97,25 @@ def main() -> None:
     print(f"[bare 1x1] {dt:.3f}s total, {per_stage * 1e3:.1f} ms/stage, "
           f"{macs_one / dt / 1e12:.3f} TMAC/s")
 
+    import json
+
+    out = {
+        "config": f"{args.model} frac={args.frac} stages={args.stages} "
+                  f"trials={args.trials} splits={args.splits}",
+        "n_rows": n,
+        "steady_s": round(steady, 3),
+        "steady_tflops": round(2 * macs_total / steady / 1e12, 2),
+        "bare_ms_per_stage": round(per_stage * 1e3, 1),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "GB_PROFILE_MEASURED.json")
+    hist = []
+    if os.path.exists(path):
+        hist = json.load(open(path))
+    hist = [h for h in hist if h["config"] != out["config"]] + [out]
+    json.dump(hist, open(path, "w"), indent=1)
+    print("wrote", path)
+
 
 if __name__ == "__main__":
     main()

@@ -30,9 +30,13 @@ from typing import Any, Dict, List, Optional
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
-from .executor import LocalExecutor
+from .executor import DeviceLostError, LocalExecutor
 
 logger = get_logger("tpuml.agent")
+
+#: agent exit status for an unrecoverable backend fault — supervisors treat
+#: any non-zero exit as restartable, but this one is self-diagnosing in logs
+DEVICE_LOST_EXIT_CODE = 13
 
 
 class WorkerAgent:
@@ -147,11 +151,25 @@ class WorkerAgent:
                 continue
             if not tasks:
                 continue
-            self.executor.run_subtasks(
-                tasks,
-                on_result=self._post_result,
-                on_metrics=self._post_metrics,
-            )
+            try:
+                self.executor.run_subtasks(
+                    tasks,
+                    on_result=self._post_result,
+                    on_metrics=self._post_metrics,
+                )
+            except DeviceLostError:
+                # fail-fast containment: this process's backend is poisoned —
+                # exit non-zero so a supervisor (runtime/supervisor.py, compose
+                # restart policy) replaces the process with a fresh backend.
+                # Pulled tasks stay in this worker's coordinator-side queue and
+                # requeue via the dead-worker sweep.
+                logger.exception(
+                    "Agent %s lost its device backend; exiting for restart",
+                    self.worker_id,
+                )
+                import os
+
+                os._exit(DEVICE_LOST_EXIT_CODE)
 
     def _post_result(self, stid: str, status: str, result: Optional[Dict[str, Any]]) -> None:
         import requests

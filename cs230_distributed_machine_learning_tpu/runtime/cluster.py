@@ -26,7 +26,7 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.config import get_config
 from ..utils.logging import get_logger
-from .executor import LocalExecutor
+from .executor import DeviceLostError, LocalExecutor
 from .queue import TopicBus
 from .scheduler import TOPIC_TASKS, TOPIC_TRAIN, PlacementEngine
 
@@ -100,6 +100,20 @@ class ExecutorWorker:
                         TOPIC_METRICS, {**msg, "worker_id": self.worker_id}, key=msg.get("subtask_id")
                     ),
                 )
+            except DeviceLostError:
+                # containment: this worker's backend is gone for good — leave
+                # the pool like a crashed worker (no unsubscribe), so the
+                # dead-worker sweep requeues its queued tasks onto survivors.
+                # The engine's queue still holds this batch (metrics feedback
+                # never fired), so nothing is lost. If this was the last
+                # executor, the job surfaces the stall via the coordinator's
+                # progress-aware timeout.
+                logger.exception(
+                    "Worker %s lost its device backend; leaving the pool",
+                    self.worker_id,
+                )
+                self.cluster.kill_executor(self.worker_id)
+                return
             except Exception:  # noqa: BLE001
                 logger.exception("Worker %s batch execution failed", self.worker_id)
 

@@ -121,6 +121,14 @@ class LocalExecutor:
                             )
                         )
             except Exception as e:  # noqa: BLE001 — task-level failure semantics
+                if _is_device_fatal(e):
+                    # a poisoned backend fails every later dispatch in this
+                    # process: do NOT publish per-task failures (the owner
+                    # keeps the tasks queued, so the dead-worker sweep can
+                    # requeue them onto live executors) — escalate instead
+                    raise DeviceLostError(
+                        f"device backend lost on {self.executor_id}: {e}"
+                    ) from e
                 logger.exception("Batch failed for %s/%s", dataset_id, model_type)
                 for gi in idxs:
                     st = subtasks[gi]
@@ -197,17 +205,62 @@ class LocalExecutor:
         return jax.profiler.trace(trace_dir)
 
 
+class DeviceLostError(RuntimeError):
+    """The executor's accelerator backend is poisoned (e.g. an UNAVAILABLE
+    RPC fault on a TPU chip): every later dispatch in this process will
+    fail, so the owning worker must leave the pool instead of emitting
+    per-task failures. Containment per runtime mode:
+
+    - remote agent (runtime/agent.py): exits the process — the scheduler's
+      dead-worker sweep requeues its tasks, and a supervisor/compose
+      restart policy brings a fresh process (and backend) back.
+    - in-process worker (runtime/cluster.py): kills itself without
+      unsubscribe, so its tasks requeue onto surviving executors.
+    """
+
+
+#: substrings marking an unrecoverable backend fault (vs a per-batch error
+#: like RESOURCE_EXHAUSTED/INVALID_ARGUMENT, which stays task-level)
+_FATAL_MARKERS = (
+    "UNAVAILABLE",
+    "DATA_LOSS",
+    "device is in an invalid state",
+    "backend has been poisoned",
+    "lost connection to the device",
+)
+
+
+def _is_device_fatal(e: BaseException) -> bool:
+    msg = f"{type(e).__name__}: {e}"
+    if isinstance(e, DeviceLostError):
+        return True
+    # a backend that never came up (e.g. two processes contending for one
+    # chip) fails every batch this process will ever run — process-fatal
+    if "Unable to initialize backend" in msg:
+        return True
+    if "XlaRuntimeError" not in msg and "DeviceLost" not in msg:
+        return False
+    return any(m in msg for m in _FATAL_MARKERS)
+
+
 class FaultInjector:
     """Test/chaos hooks (SURVEY.md §5.3: 'add real fault injection hooks'):
-    delay a host's batches, fail N batches, or drop results silently."""
+    delay a host's batches, fail N batches (task-level), drop results
+    silently, or poison the device backend (process-level)."""
 
-    def __init__(self, delay_s: float = 0.0, fail_batches: int = 0):
+    def __init__(self, delay_s: float = 0.0, fail_batches: int = 0,
+                 device_lost: bool = False):
         self.delay_s = delay_s
         self.fail_batches = fail_batches
+        self.device_lost = device_lost
 
     def before_batch(self, executor_id: str, model_type: str) -> None:
         if self.delay_s > 0:
             time.sleep(self.delay_s)
+        if self.device_lost:
+            raise DeviceLostError(
+                f"fault injection: simulated backend loss on {executor_id}"
+            )
         if self.fail_batches > 0:
             self.fail_batches -= 1
             raise RuntimeError(f"fault injection: simulated batch failure on {executor_id}")

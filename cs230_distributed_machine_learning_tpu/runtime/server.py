@@ -247,7 +247,10 @@ def main() -> None:
 
     - ``--cluster`` (default): scheduler-mediated dispatch — remote agents
       register over /subscribe; optionally ``--local-executors N`` adds
-      in-process workers so the box serves jobs with no agents attached.
+      in-process workers so the box serves jobs with no agents attached, or
+      ``--agent-executors N`` runs them as supervised child processes
+      (device-fault containment: a poisoned backend kills only the child,
+      tasks requeue, the supervisor respawns — runtime/supervisor.py).
     - ``--direct``: single in-process executor, no placement engine (the
       laptop / single-TPU-VM mode).
     The compose analog: reference docker-compose.yml:86-131 (master +
@@ -262,10 +265,14 @@ def main() -> None:
                         help="in-process executor, no placement engine")
     parser.add_argument("--local-executors", type=int, default=0, metavar="N",
                         help="cluster mode: also attach N in-process executors")
+    parser.add_argument("--agent-executors", type=int, default=0, metavar="N",
+                        help="cluster mode: run N supervised child agent "
+                             "processes (fault-isolated executors)")
     parser.add_argument("--journal", action="store_true",
                         help="journal job state; resume in-flight jobs on restart")
     args = parser.parse_args()
 
+    supervisor = None
     if args.direct:
         coord = Coordinator(journal=args.journal)
     else:
@@ -275,7 +282,32 @@ def main() -> None:
         for _ in range(max(args.local_executors, 0)):
             cluster.add_executor()
         coord = Coordinator(cluster=cluster, journal=args.journal)
-    serve(coord, host=args.host, port=args.port)
+        if args.agent_executors > 0:
+            from ..utils.config import get_config as _cfg
+            from .supervisor import AgentSupervisor, agent_command
+
+            cfg = _cfg().service
+            url = f"http://127.0.0.1:{args.port or cfg.port}"
+            # single-accelerator host policy: exactly one process may own
+            # the chip. With no in-process executors the coordinator never
+            # touches it, so agent slot 0 inherits the platform; further
+            # slots — and ALL slots when --local-executors also run in the
+            # parent (which then owns the chip) — pin to the CPU backend.
+            chip_taken = args.local_executors > 0
+            slot_envs = [
+                None if (i == 0 and not chip_taken) else {"TPUML_PLATFORM": "cpu"}
+                for i in range(args.agent_executors)
+            ]
+            supervisor = AgentSupervisor(
+                agent_command(url), n=args.agent_executors,
+                slot_envs=slot_envs,
+            )
+            supervisor.start()
+    try:
+        serve(coord, host=args.host, port=args.port)
+    finally:
+        if supervisor is not None:
+            supervisor.stop()
 
 
 if __name__ == "__main__":

@@ -45,7 +45,20 @@ def cache_dir() -> str:
 
 
 def enabled() -> bool:
-    return os.environ.get("CS230_AOT_CACHE", "1") != "0"
+    """On by default on accelerator backends; OFF on CPU. Executing a
+    deserialized CPU export has been observed to SIGSEGV in this
+    environment (same machine, same context — jaxlib CPU AOT path), and the
+    cache's payoff is the TPU fleet anyway (tests use per-test cache dirs,
+    so CPU deserialize was never a tested path). ``CS230_AOT_CACHE=force``
+    overrides; ``0`` disables everywhere."""
+    flag = os.environ.get("CS230_AOT_CACHE", "1")
+    if flag == "0":
+        return False
+    if flag == "force":
+        return True
+    import jax
+
+    return jax.default_backend() != "cpu"
 
 
 def _code_fingerprint() -> str:

@@ -21,9 +21,46 @@ def setup_jax(cache_dir: str | None = None) -> None:
     _done = True
     import jax
 
-    cache_dir = cache_dir or os.path.join(
-        os.path.expanduser("~/.tpuml"), "jax_compilation_cache"
-    )
+    # TPUML_PLATFORM=cpu|tpu pins the backend for THIS process before first
+    # backend touch. Needed by supervised child agents in tests/CI (the
+    # parent owns the only chip) and by fleets where some executors should
+    # run host-side; a plain JAX_PLATFORMS env is overridden by the axon
+    # plugin's sitecustomize, the config update is not.
+    platform = os.environ.get("TPUML_PLATFORM")
+    if platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except Exception:  # noqa: BLE001
+            pass
+
+    if platform == "cpu" and cache_dir is None:
+        # No persistent compile cache for CPU-pinned processes: reloading a
+        # serialized XLA:CPU executable has been observed to SIGSEGV in this
+        # environment (cpu_aot_loader feature-mismatch path — the entry
+        # embeds compile-machine pseudo-features like +prefer-no-scatter
+        # that host detection never reports). CPU compiles are cheap; the
+        # cache's value is the TPU path, which keeps it.
+        return
+
+    if cache_dir is None:
+        # partition the persistent cache by compilation context: XLA:CPU
+        # cache entries embed target machine features that vary with the
+        # process's XLA flags/platform (e.g. +prefer-no-scatter under the
+        # axon plugin's TPU process vs a plain CPU agent); loading an entry
+        # compiled in a different context can SIGILL (cpu_aot_loader
+        # feature-mismatch warning). Identical launch contexts share a
+        # subdirectory; different ones never see each other's binaries.
+        import hashlib
+
+        ctx = "|".join((
+            os.environ.get("XLA_FLAGS", ""),
+            os.environ.get("JAX_PLATFORMS", ""),
+            platform or "",
+        ))
+        sig = hashlib.sha256(ctx.encode()).hexdigest()[:10]
+        cache_dir = os.path.join(
+            os.path.expanduser("~/.tpuml"), "jax_compilation_cache", sig
+        )
     try:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)

@@ -19,6 +19,10 @@ def _blobs(root):
 @pytest.fixture()
 def tmp_aot_dir(tmp_path, monkeypatch):
     monkeypatch.setenv("CS230_AOT_DIR", str(tmp_path))
+    # the cache defaults OFF on the CPU test backend (deserialized CPU
+    # executables are unreliable in some environments); force it on so the
+    # round-trip machinery itself stays covered
+    monkeypatch.setenv("CS230_AOT_CACHE", "force")
     return tmp_path
 
 

@@ -85,3 +85,124 @@ def test_elastic_join_mid_stream(fast_cfg):
         assert status["job_status"] == "completed"
     finally:
         cluster.shutdown()
+
+
+def test_device_lost_executor_contained_and_requeued(fast_cfg):
+    """A poisoned backend (DeviceLostError) must remove the owning worker
+    from the pool WITHOUT failing the job: its queued tasks requeue onto the
+    survivor via the dead-worker sweep (STATUS round-2: local-mode
+    containment)."""
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        FaultInjector,
+        LocalExecutor,
+    )
+
+    cluster = ClusterRuntime()
+    try:
+        poisoned = LocalExecutor(executor_id="tmp")
+        poisoned.fault_injector = FaultInjector(device_lost=True)
+        bad_wid = cluster.add_executor(executor=poisoned)
+        good_wid = cluster.add_executor()
+
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        submit = m.train(
+            GridSearchCV(LogisticRegression(max_iter=300),
+                         {"C": [0.01, 0.1, 1.0, 10.0]}, cv=3),
+            "iris",
+            wait_for_completion=False,
+            show_progress=False,
+        )
+        status = coord.wait_for_completion(m.session_id, submit["job_id"], timeout_s=60)
+        assert status["job_status"] == "completed"
+        results = status["job_result"]["results"]
+        assert len(results) == 4
+        assert all(r["status"] == "completed" for r in results)
+        # the poisoned worker left the pool (kill path, then sweep)
+        deadline = time.time() + 5
+        while bad_wid in cluster.engine.worker_snapshot() and time.time() < deadline:
+            time.sleep(0.1)
+        assert bad_wid not in cluster.engine.worker_snapshot()
+        assert good_wid in cluster.engine.worker_snapshot()
+        assert bad_wid not in cluster.workers  # ExecutorWorker self-removed
+    finally:
+        cluster.shutdown()
+
+
+def test_device_fatal_classification():
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        DeviceLostError,
+        _is_device_fatal,
+    )
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    assert _is_device_fatal(DeviceLostError("x"))
+    assert _is_device_fatal(XlaRuntimeError("UNAVAILABLE: lost connection"))
+    assert not _is_device_fatal(XlaRuntimeError("RESOURCE_EXHAUSTED: OOM"))
+    assert not _is_device_fatal(ValueError("UNAVAILABLE"))  # not an XLA error
+    assert not _is_device_fatal(RuntimeError("bad hyperparameter"))
+
+
+def test_agent_supervisor_respawns_dead_child(tmp_path):
+    """Supervisor restart policy: a child that exits is respawned with
+    backoff; stop() terminates children."""
+    import sys
+
+    from cs230_distributed_machine_learning_tpu.runtime.supervisor import (
+        AgentSupervisor,
+    )
+
+    marker = tmp_path / "spawns"
+    # each spawn appends a line, then the child exits immediately
+    # (interpreter startup is seconds on this box, so keep counts small)
+    cmd = [sys.executable, "-c",
+           f"open(r'{marker}', 'a').write('x\\n')"]
+    sup = AgentSupervisor(cmd, n=1, backoff_s=0.1, max_backoff_s=0.2,
+                          poll_interval_s=0.05, max_restarts=1)
+    sup.start()
+    try:
+        # initial spawn + 1 respawn, then the slot gives up (restarts > max)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (marker.exists()
+                    and len(marker.read_text().splitlines()) >= 2
+                    and sup.status()[0]["gave_up"]):
+                break
+            time.sleep(0.1)
+        assert len(marker.read_text().splitlines()) == 2
+        st = sup.status()[0]
+        assert st["gave_up"] and st["restarts"] == 2
+    finally:
+        sup.stop()
+
+
+def test_supervisor_spawn_failure_backs_off(tmp_path):
+    """A persistently failing Popen must consume the restart budget with
+    backoff, not retry every poll tick forever."""
+    from cs230_distributed_machine_learning_tpu.runtime.supervisor import (
+        AgentSupervisor,
+    )
+
+    sup = AgentSupervisor([str(tmp_path / "no-such-binary")], n=1,
+                          backoff_s=0.05, max_backoff_s=0.1,
+                          poll_interval_s=0.02, max_restarts=2)
+    sup.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not sup.status()[0]["gave_up"]:
+            time.sleep(0.05)
+        st = sup.status()[0]
+        assert st["gave_up"] and st["pid"] is None
+    finally:
+        sup.stop()
+
+
+def test_backend_init_failure_is_device_fatal():
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        _is_device_fatal,
+    )
+
+    assert _is_device_fatal(RuntimeError(
+        "Unable to initialize backend 'tpu': ALREADY_EXISTS: device in use"))

@@ -220,3 +220,59 @@ def test_covertype_quarter_rf_parity():
         RandomForestClassifier(n_estimators=100, random_state=0), X, y, cv=5
     ).mean()
     assert ours > sk - 0.03, (ours, sk)
+
+
+def test_gather_free_ops_match_reference_forms():
+    """The MXU forms in ops/trees (_route_left, _leaf_sums, _leaf_select,
+    triangular-ones prefix sums in _split_gain) must reproduce the gather /
+    segment_sum / cumsum formulations they replaced (profiled 10-30x faster
+    on TPU at production trial batches)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs230_distributed_machine_learning_tpu.ops import trees as ot
+
+    rng = np.random.default_rng(7)
+    n, d, nb, m, k = 4096, 12, 32, 8, 3
+    xb = jnp.asarray(rng.integers(0, nb, (n, d)), jnp.int32)
+    local = jnp.asarray(rng.integers(0, m, (n,)), jnp.int32)
+    bf = jnp.asarray(rng.integers(0, d, (m,)), jnp.int32)
+    bb = jnp.asarray(rng.integers(0, nb, (m,)), jnp.int32)
+
+    want = xb[jnp.arange(n), bf[local]] <= bb[local]
+    got = ot._route_left(xb, local, bf, bb, nb)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    SC = jnp.asarray(rng.normal(size=(n, k + 1)), jnp.float32)
+    leaf = jnp.asarray(rng.integers(0, 2 * m, (n,)), jnp.int32)
+    want_sums = jax.ops.segment_sum(SC, leaf, num_segments=2 * m)
+    got_sums = ot._leaf_sums(leaf, SC, 2 * m)
+    np.testing.assert_allclose(
+        np.asarray(got_sums), np.asarray(want_sums), rtol=1e-5, atol=1e-4
+    )
+
+    V = jnp.asarray(rng.normal(size=(2 * m, k)), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(ot._leaf_select(leaf, V, 2 * m)), np.asarray(V[leaf])
+    )
+
+    H = jnp.asarray(rng.uniform(0, 5, (m, d, nb, k + 1)), jnp.float32)
+    gain = ot._split_gain(H, k, nb, 1.0)
+    Sh, Ch = H[..., :k], jnp.maximum(H[..., k], 0.0)
+    Scum, Ccum = jnp.cumsum(Sh, axis=2), jnp.cumsum(Ch, axis=2)
+    Sr, Cr = Scum[:, :, -1:, :] - Scum, Ccum[:, :, -1:] - Ccum
+    ref = jnp.sum(Scum**2, -1) / jnp.maximum(Ccum, 1e-12) + jnp.sum(
+        Sr**2, -1
+    ) / jnp.maximum(Cr, 1e-12)
+    ref = ref - jnp.sum(Scum[:, :, -1:, :] ** 2, -1) / jnp.maximum(
+        Ccum[:, :, -1:], 1e-12
+    )
+    valid = (Ccum >= 1.0) & (Cr >= 1.0) & (
+        jnp.arange(nb)[None, None, :] < nb - 1
+    )
+    ref = jnp.where(valid, ref, -jnp.inf)
+    fin = np.isfinite(np.asarray(ref))
+    np.testing.assert_array_equal(fin, np.isfinite(np.asarray(gain)))
+    np.testing.assert_allclose(
+        np.asarray(gain)[fin], np.asarray(ref)[fin], rtol=1e-4, atol=1e-3
+    )
