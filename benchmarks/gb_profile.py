@@ -110,10 +110,19 @@ def main() -> None:
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "GB_PROFILE_MEASURED.json")
     hist = []
-    if os.path.exists(path):
-        hist = json.load(open(path))
-    hist = [h for h in hist if h["config"] != out["config"]] + [out]
-    json.dump(hist, open(path, "w"), indent=1)
+    try:
+        with open(path) as f:
+            hist = json.load(f)
+    except (OSError, ValueError):  # missing/truncated history: start fresh
+        hist = []
+    if not isinstance(hist, list):
+        hist = []
+    hist = [h for h in hist if isinstance(h, dict)
+            and h.get("config") != out["config"]] + [out]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(hist, f, indent=1)
+    os.replace(tmp, path)
     print("wrote", path)
 
 

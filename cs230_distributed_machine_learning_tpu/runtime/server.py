@@ -43,6 +43,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/download_model/<sid>/<jid>", endpoint="download_model", methods=["GET"]),
             Rule("/workers", endpoint="workers", methods=["GET"]),
             Rule("/queues", endpoint="queues", methods=["GET"]),
+            Rule("/supervisor", endpoint="supervisor", methods=["GET"]),
             # worker-agent control plane (reference scheduler.py:95-159)
             Rule("/subscribe", endpoint="subscribe", methods=["POST"]),
             Rule("/unsubscribe/<wid>", endpoint="unsubscribe", methods=["POST"]),
@@ -83,7 +84,18 @@ def create_app(coordinator: Optional[Coordinator] = None):
         )
 
     def health(request):
-        return _json({"status": "ok"})
+        out = {"status": "ok"}
+        sup = getattr(coord, "agent_supervisor", None)
+        if sup is not None:
+            slots = sup.status()
+            out["agent_slots"] = {
+                "alive": sum(1 for s in slots if s["alive"]),
+                "total": len(slots),
+                "gave_up": sum(1 for s in slots if s["gave_up"]),
+            }
+            if out["agent_slots"]["gave_up"] == len(slots) and slots:
+                out["status"] = "degraded"  # every executor slot is down
+        return _json(out)
 
     def create_session(request):
         return _json({"session_id": coord.create_session()}, status=201)
@@ -143,6 +155,10 @@ def create_app(coordinator: Optional[Coordinator] = None):
         if coord.cluster is None:
             return _json({})
         return _json(coord.cluster.engine.queue_snapshot())
+
+    def supervisor(request):
+        sup = getattr(coord, "agent_supervisor", None)
+        return _json(sup.status() if sup is not None else [])
 
     def _cluster_or_400():
         if coord.cluster is None:
@@ -271,8 +287,30 @@ def main() -> None:
     parser.add_argument("--journal", action="store_true",
                         help="journal job state; resume in-flight jobs on restart")
     args = parser.parse_args()
+    if args.direct and args.agent_executors > 0:
+        parser.error("--agent-executors requires cluster mode (drop --direct)")
 
     supervisor = None
+    slot_envs = None
+    if args.agent_executors > 0:
+        import os as _os
+
+        # single-accelerator host policy: exactly one process may own the
+        # chip. The parent pins itself to CPU and agent slot 0 inherits the
+        # original platform — unless --local-executors run in the parent,
+        # which then keeps the chip and every child slot pins to CPU. This
+        # MUST happen before Coordinator() below: its eager artifact-refit
+        # executor latches the platform via setup_jax on construction.
+        chip_taken = args.local_executors > 0
+        inherit = {"TPUML_PLATFORM": _os.environ.get("TPUML_PLATFORM")}
+        if not chip_taken:
+            _os.environ["TPUML_PLATFORM"] = "cpu"
+        slot_envs = [
+            inherit if (i == 0 and not chip_taken)
+            else {"TPUML_PLATFORM": "cpu"}
+            for i in range(args.agent_executors)
+        ]
+
     if args.direct:
         coord = Coordinator(journal=args.journal)
     else:
@@ -287,22 +325,17 @@ def main() -> None:
             from .supervisor import AgentSupervisor, agent_command
 
             cfg = _cfg().service
-            url = f"http://127.0.0.1:{args.port or cfg.port}"
-            # single-accelerator host policy: exactly one process may own
-            # the chip. With no in-process executors the coordinator never
-            # touches it, so agent slot 0 inherits the platform; further
-            # slots — and ALL slots when --local-executors also run in the
-            # parent (which then owns the chip) — pin to the CPU backend.
-            chip_taken = args.local_executors > 0
-            slot_envs = [
-                None if (i == 0 and not chip_taken) else {"TPUML_PLATFORM": "cpu"}
-                for i in range(args.agent_executors)
-            ]
+            # children must dial an address the bound server answers on:
+            # wildcard binds answer loopback, a specific --host only itself
+            host = args.host or cfg.host
+            dial = "127.0.0.1" if host in (None, "", "0.0.0.0", "::") else host
+            url = f"http://{dial}:{args.port or cfg.port}"
             supervisor = AgentSupervisor(
                 agent_command(url), n=args.agent_executors,
                 slot_envs=slot_envs,
             )
             supervisor.start()
+            coord.agent_supervisor = supervisor
     try:
         serve(coord, host=args.host, port=args.port)
     finally:

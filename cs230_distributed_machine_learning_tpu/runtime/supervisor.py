@@ -38,9 +38,10 @@ class AgentSupervisor:
     reported via ``status()`` (``restarts_total`` keeps the lifetime count).
 
     ``slot_envs`` (optional, one dict per slot) overlays environment
-    variables onto a slot's children — used to pin all but one slot to the
-    CPU backend (``TPUML_PLATFORM=cpu``) on a single-accelerator host, where
-    only one process can own the chip.
+    variables onto a slot's children (a ``None`` value unsets the variable)
+    — used to pin all but one slot to the CPU backend
+    (``TPUML_PLATFORM=cpu``) on a single-accelerator host, where only one
+    process can own the chip.
     """
 
     def __init__(
@@ -83,10 +84,15 @@ class AgentSupervisor:
     def _spawn(self, i: int) -> None:
         try:
             env = None
-            if self.slot_envs and self.slot_envs[i]:
+            if self.slot_envs and self.slot_envs[i] is not None:
                 import os
 
-                env = {**os.environ, **self.slot_envs[i]}
+                env = {**os.environ}
+                for k, v in self.slot_envs[i].items():
+                    if v is None:  # overlay None = unset in the child
+                        env.pop(k, None)
+                    else:
+                        env[k] = v
             self._procs[i] = subprocess.Popen(self.command, env=env)
             self._started_at[i] = time.time()
             logger.info(

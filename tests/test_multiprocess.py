@@ -197,3 +197,92 @@ def test_coordinator_staged_dataset_reaches_remote_agent(fleet, tmp_path):
     result = status["job_result"]
     assert len(result["results"]) == 2 and not result.get("failed"), tail(agent_log)
     assert result["best_result"]["mean_cv_score"] > 0.8
+
+
+def test_supervised_agent_cli_respawn(tmp_path):
+    """The ``tpuml-coordinator --agent-executors 1`` surface end-to-end:
+    a job completes through a supervised child agent; killing the child
+    respawns it and the next job completes (device-fault containment,
+    runtime/supervisor.py)."""
+    import json
+    import signal
+
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    # the whole family (server + child agents) runs on the CPU backend;
+    # slot 0 would otherwise inherit the accelerator
+    env["TPUML_PLATFORM"] = "cpu"
+    env.pop("JAX_PLATFORMS", None)
+    log = open(tmp_path / "coordinator.log", "w+")
+
+    def _tail():
+        log.flush()
+        log.seek(0)
+        return log.read()[-3000:]
+
+    def _agent_pids():
+        out = subprocess.run(
+            ["pgrep", "-f", f"runtime.agent.*{port}"],
+            capture_output=True, text=True,
+        )
+        return [int(p) for p in out.stdout.split()]
+
+    srv = subprocess.Popen(
+        [sys.executable, "-m",
+         "cs230_distributed_machine_learning_tpu.runtime.server",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--agent-executors", "1"],
+        env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        url = f"http://127.0.0.1:{port}"
+        assert _wait_http(f"{url}/health", proc=srv), (
+            f"coordinator did not come up:\n{_tail()}"
+        )
+        from sklearn.linear_model import LogisticRegression
+
+        from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+        m = MLTaskManager(url=url)
+        s1 = m.train(LogisticRegression(max_iter=300), "iris",
+                     show_progress=False, timeout=240)
+        assert s1["job_status"] == "completed", _tail()
+
+        with urllib.request.urlopen(f"{url}/supervisor", timeout=5) as r:
+            slots = json.load(r)
+        assert len(slots) == 1 and slots[0]["alive"], slots
+        with urllib.request.urlopen(f"{url}/health", timeout=5) as r:
+            h = json.load(r)
+        assert h["agent_slots"]["total"] == 1, h
+
+        pids = _agent_pids()
+        assert pids, f"no child agent found:\n{_tail()}"
+        os.kill(pids[0], signal.SIGKILL)
+
+        s2 = m.train(LogisticRegression(C=0.5, max_iter=300), "iris",
+                     show_progress=False, timeout=240)
+        assert s2["job_status"] == "completed", _tail()
+        deadline = time.time() + 60
+        while time.time() < deadline and not (
+            set(_agent_pids()) - set(pids)
+        ):
+            time.sleep(0.5)
+        assert set(_agent_pids()) - set(pids), (
+            f"child was not respawned:\n{_tail()}"
+        )
+    finally:
+        srv.terminate()
+        try:
+            srv.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            srv.kill()
+        subprocess.run(["pkill", "-f", f"runtime.agent.*{port}"],
+                       capture_output=True)
+        log.close()
