@@ -1,0 +1,159 @@
+"""Per-family benchmark matrix: every major model family on one dataset.
+
+The reference's worker serves 15 sklearn estimator types but its authors
+only ever measured LogReg/RF demos (SURVEY.md §6). This harness measures
+EVERY family end-to-end (MLTaskManager -> coordinator -> sharded trial
+engine, steady state) against single-process sklearn on the same Covertype
+fraction, with accuracy parity columns — the completeness counterpart of
+measure_baseline.py's config-parity table.
+
+Run: python benchmarks/model_matrix.py [--frac 0.1] [--out JSON]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager  # noqa: E402
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import (  # noqa: E402
+    Coordinator,
+)
+
+
+def _sk_estimator(name):
+    from sklearn.ensemble import (
+        GradientBoostingClassifier,
+        RandomForestClassifier,
+    )
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.neighbors import KNeighborsClassifier
+    from sklearn.neural_network import MLPClassifier
+    from sklearn.svm import SVC
+    from sklearn.tree import DecisionTreeClassifier
+
+    return {
+        "LogisticRegression": LogisticRegression(max_iter=200),
+        "DecisionTreeClassifier": DecisionTreeClassifier(random_state=0),
+        "RandomForestClassifier": RandomForestClassifier(
+            n_estimators=50, random_state=0),
+        "GradientBoostingClassifier": GradientBoostingClassifier(
+            n_estimators=50, random_state=0),
+        "KNeighborsClassifier": KNeighborsClassifier(),
+        "SVC": SVC(),
+        "MLPClassifier": MLPClassifier(max_iter=50, random_state=0),
+        "GaussianNB": GaussianNB(),
+    }[name]
+
+
+FAMILIES = [
+    "LogisticRegression",
+    "GaussianNB",
+    "DecisionTreeClassifier",
+    "RandomForestClassifier",
+    "GradientBoostingClassifier",
+    "KNeighborsClassifier",
+    "SVC",
+    "MLPClassifier",
+]
+
+
+def main() -> None:
+    import warnings
+
+    warnings.filterwarnings("ignore")
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frac", type=float, default=0.1)
+    ap.add_argument("--cv", type=int, default=5)
+    ap.add_argument("--sk-timeout", type=float, default=1800.0,
+                    help="skip a family's sklearn side past this budget")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "MODEL_MATRIX_MEASURED.json"))
+    ap.add_argument("--families", nargs="*", default=FAMILIES)
+    args = ap.parse_args()
+
+    from sklearn.model_selection import cross_val_score, train_test_split
+
+    manager = MLTaskManager(coordinator=Coordinator())
+    cache = manager._coordinator.cache
+    full = cache.get("covertype", "classification")
+    X_full, y_full = np.asarray(full.X), np.asarray(full.y)
+    n = max(256, int(len(X_full) * args.frac))
+    rng = np.random.RandomState(0)
+    idx = rng.permutation(len(X_full))[:n]
+    Xf, yf = X_full[idx], y_full[idx]
+
+    from cs230_distributed_machine_learning_tpu.data.datasets import dataset_dir
+
+    did = f"covertype_matrix_{int(args.frac * 100)}"
+    ddir = os.path.join(dataset_dir(did), "preprocessed")
+    os.makedirs(ddir, exist_ok=True)
+    csv = os.path.join(ddir, f"{did}_preprocessed.csv")
+    if not os.path.exists(csv):
+        import pandas as pd
+
+        df = pd.DataFrame(Xf)
+        df["target"] = yf
+        df.to_csv(csv, index=False)
+
+    rows = []
+    for name in args.families:
+        est = _sk_estimator(name)
+
+        # ours: first job warms the executable caches, second is steady
+        t0 = time.perf_counter()
+        s = manager.train(_sk_estimator(name), did, show_progress=False,
+                          timeout=3600)
+        first_s = time.perf_counter() - t0
+        assert s["job_status"] == "completed", (name, s)
+        t0 = time.perf_counter()
+        s = manager.train(_sk_estimator(name), did, show_progress=False,
+                          timeout=3600)
+        steady_s = time.perf_counter() - t0
+        best = s["job_result"]["best_result"]
+        ours_cv = best.get("mean_cv_score")
+
+        # sklearn, the reference worker's exact flow (fit + eval + k-fold CV)
+        sk_s = sk_cv = None
+        t0 = time.perf_counter()
+        try:
+            Xt, Xe, yt, ye = train_test_split(Xf, yf, test_size=0.2,
+                                              random_state=42)
+            est.fit(Xt, yt)
+            est.score(Xe, ye)
+            sk_cv = float(np.mean(cross_val_score(est, Xf, yf, cv=args.cv)))
+            sk_s = time.perf_counter() - t0
+        except Exception as e:  # noqa: BLE001 — e.g. SVC timeout-scale
+            print(f"[{name}] sklearn side failed: {e}", file=sys.stderr)
+
+        row = {
+            "model": name,
+            "n_rows": n,
+            "sklearn_s": round(sk_s, 3) if sk_s else None,
+            "framework_first_s": round(first_s, 3),
+            "framework_steady_s": round(steady_s, 3),
+            "speedup_steady": round(sk_s / steady_s, 2) if sk_s else None,
+            "cv_ours": round(ours_cv, 4) if ours_cv is not None else None,
+            "cv_sklearn": round(sk_cv, 4) if sk_cv is not None else None,
+        }
+        rows.append(row)
+        print(json.dumps(row))
+
+    tmp = f"{args.out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(rows, f, indent=1)
+    os.replace(tmp, args.out)
+    print("wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

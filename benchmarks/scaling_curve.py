@@ -89,6 +89,7 @@ def main() -> None:
         # sklearn reference (worker.py:289-349 semantics), capped for the
         # largest fractions via linear extrapolation from the previous point
         sk_time = None
+        sk_cv = None
         extrapolated = False
         if not sk_skipped:
             model = _estimator()
@@ -96,7 +97,7 @@ def main() -> None:
             Xt, Xe, yt, ye = train_test_split(Xf, yf, test_size=0.2, random_state=42)
             model.fit(Xt, yt)
             model.score(Xe, ye)
-            cross_val_score(model, Xf, yf, cv=5)
+            sk_cv = float(np.mean(cross_val_score(model, Xf, yf, cv=5)))
             sk_time = time.time() - t0
             if sk_time > SK_FULL_CAP_S:
                 sk_skipped = True  # larger fractions: extrapolate
@@ -118,10 +119,10 @@ def main() -> None:
             assert status["job_status"] == "completed", status
             result = status["job_result"]
             assert len(result["results"]) == 1 and not result.get("failed"), result
-            return dt
+            return dt, result["best_result"].get("mean_cv_score")
 
-        wall = _timed_ok()
-        steady = _timed_ok()
+        wall, ours_cv = _timed_ok()
+        steady, _ = _timed_ok()
 
         report.append(
             {
@@ -131,12 +132,16 @@ def main() -> None:
                 "sklearn_extrapolated": extrapolated,
                 "framework_s": round(wall, 3),
                 "framework_steady_s": round(steady, 3),
+                "cv_ours": round(ours_cv, 4) if ours_cv is not None else None,
+                "cv_sklearn": round(sk_cv, 4) if sk_cv is not None else None,
             }
         )
         print(
             f"frac {frac:>5.0%} ({n:>7} rows): sklearn {sk_time:7.2f}s"
             f"{'~' if extrapolated else ' '} ours {wall:6.2f}s"
             f" (steady {steady:6.2f}s)"
+            f"  cv {ours_cv if ours_cv is not None else float('nan'):.4f}"
+            f" vs sk {sk_cv if sk_cv is not None else float('nan'):.4f}"
         )
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "SCALING_MEASURED.json")

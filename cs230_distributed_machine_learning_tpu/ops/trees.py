@@ -227,6 +227,18 @@ def _node_feature_mask(gain, node_ids, key, max_features: Optional[int], d: int)
     return jnp.where(allowed[:, :, None], gain, -jnp.inf)
 
 
+def _hist_with_count(local, xb, SC, n_nodes, n_bins, precision, k,
+                     count_from_stats: bool):
+    """Level histogram [m, d, nb, k+1]. When the stat columns sum to the
+    count column exactly (classification: S = one_hot(y) * w, C = w), the
+    count histogram is derived as the sum over class histograms instead of
+    contracting an extra column — one fewer MXU row per node, exact."""
+    if not count_from_stats:
+        return _level_histogram(local, xb, SC, n_nodes, n_bins, precision)
+    H = _level_histogram(local, xb, SC[:, :k], n_nodes, n_bins, precision)
+    return jnp.concatenate([H, jnp.sum(H, axis=-1, keepdims=True)], axis=-1)
+
+
 def build_tree(
     xb,
     S,
@@ -238,6 +250,7 @@ def build_tree(
     max_features: Optional[int] = None,
     key=None,
     precision=jax.lax.Precision.HIGHEST,
+    count_from_stats: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Fit one tree.
 
@@ -277,11 +290,13 @@ def build_tree(
         # dim), right = parent − left (exact for integer stats; gains clamp
         # the f32 cancellation tails) — halves total histogram work.
         if level == 0:
-            H = _level_histogram(local, xb, SC, n_nodes, n_bins, precision)
+            H = _hist_with_count(local, xb, SC, n_nodes, n_bins, precision,
+                                 k, count_from_stats)
         else:
             went_left = (local % 2 == 0).astype(SC.dtype)
-            H_left = _level_histogram(
-                local // 2, xb, SC * went_left[:, None], n_nodes // 2, n_bins, precision
+            H_left = _hist_with_count(
+                local // 2, xb, SC * went_left[:, None], n_nodes // 2, n_bins,
+                precision, k, count_from_stats,
             )
             H = jnp.stack([H_left, H_prev - H_left], axis=1).reshape(
                 n_nodes, d, n_bins, k + 1
@@ -341,6 +356,7 @@ def build_tree_deep(
     max_features: Optional[int] = None,
     key=None,
     precision=jax.lax.Precision.HIGHEST,
+    count_from_stats: bool = False,
 ) -> Dict[str, jnp.ndarray]:
     """Deep tree via frontier-compacted level-wise growth (batched best-first).
 
@@ -387,7 +403,7 @@ def build_tree_deep(
 
     # root: full histogram + its best split
     frontier = jnp.zeros((1,), jnp.int32)
-    H = _level_histogram(node, xb, SC, 1, n_bins, precision)
+    H = _hist_with_count(node, xb, SC, 1, n_bins, precision, k, count_from_stats)
     g = _split_gain(H, k, n_bins, min_samples_leaf)
     g = _node_feature_mask(g, frontier, key, max_features, d)
     gain, bf, bb = _pick_best(g, n_bins)
@@ -429,7 +445,8 @@ def build_tree_deep(
         # children's histograms: left by matmul over parent slots, right by
         # subtraction (exact for integer stats; float tails are gain-clamped)
         local_left = jnp.where(sp & go_left, slot, W_l)
-        H_L = _level_histogram(local_left, xb, SC, W_l, n_bins, precision)
+        H_L = _hist_with_count(local_left, xb, SC, W_l, n_bins, precision,
+                               k, count_from_stats)
         H_R = H - H_L
         cand_H = jnp.concatenate([H_L, H_R], axis=0)  # [2*W_l, d, bins, k+1]
         cand_id = jnp.concatenate(

@@ -1,0 +1,69 @@
+"""Process-level JAX setup (utils/jax_setup.py): platform pinning and
+persistent-cache policy. Fresh subprocesses — setup_jax latches per process.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, "-c", script], env=env, cwd=REPO,
+        capture_output=True, text=True, timeout=180,
+    )
+
+
+def test_tpuml_platform_pins_backend():
+    r = _run(
+        "from cs230_distributed_machine_learning_tpu.utils.jax_setup import setup_jax\n"
+        "setup_jax()\n"
+        "import jax\n"
+        "print('BACKEND=' + jax.default_backend())\n",
+        {"TPUML_PLATFORM": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "BACKEND=cpu" in r.stdout, r.stdout
+
+
+def test_cpu_pin_skips_persistent_compile_cache():
+    r = _run(
+        "from cs230_distributed_machine_learning_tpu.utils.jax_setup import setup_jax\n"
+        "setup_jax()\n"
+        "import jax\n"
+        "print('CACHEDIR=' + str(jax.config.jax_compilation_cache_dir))\n",
+        {"TPUML_PLATFORM": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "CACHEDIR=None" in r.stdout, r.stdout
+
+
+def test_cache_dir_partitioned_by_context():
+    script = (
+        "from cs230_distributed_machine_learning_tpu.utils.jax_setup import setup_jax\n"
+        "setup_jax()\n"
+        "import jax\n"
+        "print('CACHEDIR=' + str(jax.config.jax_compilation_cache_dir))\n"
+    )
+    a = _run(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
+    b = _run(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    assert a.returncode == 0 and b.returncode == 0, (a.stderr[-300:], b.stderr[-300:])
+    da = a.stdout.split("CACHEDIR=")[1].strip()
+    db = b.stdout.split("CACHEDIR=")[1].strip()
+    assert da != db and da != "None" and db != "None", (da, db)
+
+
+def test_aot_cache_disabled_on_cpu_backend():
+    r = _run(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from cs230_distributed_machine_learning_tpu.utils import aot_cache\n"
+        "print('ENABLED=' + str(aot_cache.enabled()))\n",
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "ENABLED=False" in r.stdout, r.stdout

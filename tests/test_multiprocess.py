@@ -37,6 +37,14 @@ agent.run_forever()
 """
 
 
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _wait_http(url, timeout=60, proc=None):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -56,11 +64,7 @@ def fleet(tmp_path, request):
     reference's shared volume; ``split_root`` gives the agent its own
     storage root, so coordinator-staged datasets are only reachable through
     the DCN fetch-on-miss path (GET /dataset/<id>)."""
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = _free_port()
     env = dict(os.environ)
     env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -207,11 +211,7 @@ def test_supervised_agent_cli_respawn(tmp_path):
     import json
     import signal
 
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+    port = _free_port()
     env = dict(os.environ)
     env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
