@@ -26,7 +26,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from cs230_distributed_machine_learning_tpu import MLTaskManager  # noqa: E402
 from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator  # noqa: E402
 
-FRACTIONS = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0)  # results3.py:20
+# results3.py:20; CS230_SCALING_FRACTIONS="0.01,0.05" re-measures a subset,
+# merging into the existing JSON by fraction (partial refresh after a
+# change that only affects some scales)
+FRACTIONS = tuple(
+    float(f) for f in os.environ.get(
+        "CS230_SCALING_FRACTIONS", "0.01,0.05,0.1,0.25,0.5,1.0"
+    ).split(",")
+)
 MODEL = os.environ.get("SCALE_MODEL", "RandomForestClassifier")
 SK_FULL_CAP_S = float(os.environ.get("SCALE_SK_CAP_S", 120))
 
@@ -145,8 +152,25 @@ def main() -> None:
         )
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "SCALING_MEASURED.json")
-    with open(out, "w") as f:
-        json.dump({"model": MODEL, "points": report}, f, indent=2)
+    points = report
+    default_set = {0.01, 0.05, 0.1, 0.25, 0.5, 1.0}
+    if os.path.exists(out) and set(FRACTIONS) < default_set:
+        try:  # partial run: merge into the existing SAME-MODEL curve
+            with open(out) as f:
+                old = json.load(f)
+            if old.get("model") == MODEL:
+                fresh = {p["fraction"] for p in report}
+                points = sorted(
+                    [p for p in old.get("points", [])
+                     if p.get("fraction") not in fresh] + report,
+                    key=lambda p: p["fraction"],
+                )
+        except (OSError, ValueError):
+            pass
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"model": MODEL, "points": points}, f, indent=2)
+    os.replace(tmp, out)
     print(f"wrote {out}")
 
 

@@ -72,7 +72,12 @@ _DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "64"))
 
 def _deep_n_threshold() -> int:
     """Sample count above which grow-to-purity kernels use the deep builder
-    (env-tunable so CPU tests can exercise the deep path on small data)."""
+    (env-tunable so CPU tests can exercise the deep path on small data).
+    Measured at the boundary (1162-row Covertype, RF-100 vs sklearn cv
+    0.511): complete builder cv 0.488 / 1.7 s; deep cv 0.517 or 0.485 / 4.3 s
+    depending on the sample draw — the CV differences are within 5-fold
+    noise at that n (±0.015) while the 2.4x time cost is real, so the
+    threshold stays at 4096 where the depth cap starts to bind for real."""
     return int(os.environ.get("CS230_TREE_DEEP_N", "4096"))
 
 
