@@ -97,9 +97,11 @@ def _nesterov_primal(Z, grad_fn, L_est, steps):
     return w
 
 
-def _project_box_ascent(Q, lin, lo, hi, steps=_PG_STEPS):
+def _project_box_ascent(Q, lin, lo, hi, steps=None):
     """max_a  lin.a - 0.5 a'Qa  s.t. lo <= a <= hi, by projected gradient
     with a power-iteration step size."""
+    if steps is None:  # read at call time so sweeps/env can retune
+        steps = int(os.environ.get("CS230_SVM_PG_STEPS", _PG_STEPS))
     n = Q.shape[0]
     v = jnp.ones((n,), jnp.float32)
 
