@@ -21,11 +21,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from cs230_distributed_machine_learning_tpu import MLTaskManager  # noqa: E402
-from cs230_distributed_machine_learning_tpu.runtime.coordinator import (  # noqa: E402
-    Coordinator,
-)
+# framework imports live in main(): spawned sklearn children re-execute this
+# module's top level, and they must not pay the JAX/framework import
 
 
 def _sk_estimator(name):
@@ -52,6 +49,28 @@ def _sk_estimator(name):
         "MLPClassifier": MLPClassifier(max_iter=50, random_state=0),
         "GaussianNB": GaussianNB(),
     }[name]
+
+
+def _sk_side(q, est, Xf, yf, cv):
+    """sklearn denominator, run in a spawned child (module-level so the
+    target pickles under the 'spawn' start method)."""
+    try:
+        import time as _time
+
+        import numpy as _np
+        from sklearn.model_selection import (
+            cross_val_score as _cvs,
+            train_test_split as _tts,
+        )
+
+        t0 = _time.perf_counter()
+        Xt, Xe, yt, ye = _tts(Xf, yf, test_size=0.2, random_state=42)
+        est.fit(Xt, yt)
+        est.score(Xe, ye)
+        cv_score = float(_np.mean(_cvs(est, Xf, yf, cv=cv)))
+        q.put((_time.perf_counter() - t0, cv_score))
+    except Exception as e:  # noqa: BLE001
+        q.put(e)
 
 
 FAMILIES = [
@@ -81,7 +100,10 @@ def main() -> None:
     ap.add_argument("--families", nargs="*", default=FAMILIES)
     args = ap.parse_args()
 
-    from sklearn.model_selection import cross_val_score, train_test_split
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
 
     manager = MLTaskManager(coordinator=Coordinator())
     cache = manager._coordinator.cache
@@ -138,20 +160,12 @@ def main() -> None:
         sk_s = sk_cv = None
         import multiprocessing as mp
 
-        def _sk_side(q):
-            try:
-                t0 = time.perf_counter()
-                Xt, Xe, yt, ye = train_test_split(Xf, yf, test_size=0.2,
-                                                  random_state=42)
-                est.fit(Xt, yt)
-                est.score(Xe, ye)
-                cv = float(np.mean(cross_val_score(est, Xf, yf, cv=args.cv)))
-                q.put((time.perf_counter() - t0, cv))
-            except Exception as e:  # noqa: BLE001
-                q.put(e)
-
-        q = mp.get_context("fork").Queue()
-        proc = mp.get_context("fork").Process(target=_sk_side, args=(q,))
+        # spawn, not fork: the parent has initialized JAX by now and a
+        # forked child can deadlock on its locks; the child only needs
+        # sklearn + the (picklable) arrays
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        proc = ctx.Process(target=_sk_side, args=(q, est, Xf, yf, args.cv))
         proc.start()
         proc.join(timeout=args.sk_timeout)
         if proc.is_alive():
@@ -160,11 +174,25 @@ def main() -> None:
             print(f"[{name}] sklearn side exceeded {args.sk_timeout:.0f}s; "
                   f"skipped", file=sys.stderr)
         else:
-            got = q.get() if not q.empty() else None
+            # q.empty() races the Queue feeder thread right after join();
+            # a blocking get with a short timeout sees the result reliably
+            import queue as _queue
+
+            got = None
+            if proc.exitcode == 0:
+                try:
+                    got = q.get(timeout=5)
+                except _queue.Empty:
+                    pass
             if isinstance(got, tuple):
                 sk_s, sk_cv = got
             elif got is not None:
                 print(f"[{name}] sklearn side failed: {got}", file=sys.stderr)
+            elif proc.exitcode != 0:
+                # abnormal child death (segfault/OOM-kill) posts nothing;
+                # surface it instead of a silent null row
+                print(f"[{name}] sklearn child died rc={proc.exitcode}",
+                      file=sys.stderr)
 
         row = {
             "model": name,

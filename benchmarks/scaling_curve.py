@@ -153,9 +153,9 @@ def main() -> None:
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "SCALING_MEASURED.json")
     points = report
-    default_set = {0.01, 0.05, 0.1, 0.25, 0.5, 1.0}
-    if os.path.exists(out) and set(FRACTIONS) < default_set:
-        try:  # partial run: merge into the existing SAME-MODEL curve
+    if os.path.exists(out):
+        try:  # merge by fraction into any existing SAME-MODEL curve, so a
+            # partial run (any fraction subset) never drops measured points
             with open(out) as f:
                 old = json.load(f)
             if old.get("model") == MODEL:

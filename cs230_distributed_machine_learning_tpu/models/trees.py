@@ -70,6 +70,26 @@ _DEEP_W = int(os.environ.get("CS230_DEEP_W", "256"))
 _DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "64"))
 
 
+_deep_bins_warned: set = set()
+
+
+def _warn_deep_bins_clamp(requested: int) -> None:
+    """Once-per-process notice that the deep arena overrides an explicitly
+    requested finer n_bins (CS230_DEEP_BINS cap) — callers otherwise can't
+    detect the divergence (ADVICE r2)."""
+    if requested in _deep_bins_warned:
+        return
+    _deep_bins_warned.add(requested)
+    from ..utils import get_logger
+
+    get_logger().warning(
+        "deep-tree arena clamps requested n_bins=%d to %d "
+        "(CS230_DEEP_BINS; large-n grow-to-purity path only)",
+        requested,
+        _DEEP_BINS_CAP,
+    )
+
+
 def _deep_n_threshold() -> int:
     """Sample count above which grow-to-purity kernels use the deep builder
     (env-tunable so CPU tests can exercise the deep path on small data).
@@ -129,6 +149,8 @@ class _TreeBase(ModelKernel):
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
             # like the depth caps, this deliberately overrides a finer
             # user-requested binning for the deep path only
+            if "n_bins" in static and n_bins > _DEEP_BINS_CAP:
+                _warn_deep_bins_clamp(n_bins)
             n_bins = min(n_bins, _DEEP_BINS_CAP)
         elif depth is None:
             # small data: the complete-tree builder to ~log2(n) levels is
@@ -164,16 +186,36 @@ class _TreeBase(ModelKernel):
         buffers) plus the binned dataset — 16x growth from depth 10 to 14
         must throttle trials-per-dispatch accordingly. Deep (arena) mode is
         frontier-bounded instead: ~4 histogram-sized buffers of W rows
-        (H, left+right candidates, gathered next-H)."""
+        (H, left+right candidates, gathered next-H).
+
+        The complete builder's gather-free routing/leaf forms
+        (ops/trees._route_left/_leaf_sums/_leaf_select) additionally
+        materialize [n, m] one-hot/compare buffers over the FULL row count
+        (m = min(2^level, _LOOKUP_M) columns, several f32/bool operands
+        live at once, not row-chunked) — at large n these dominate the
+        histogram term and must count toward the dispatch throttle. The
+        deep arena routes by O(n) gathers, so only the histogram and
+        dataset terms apply there."""
+        from ..ops.trees import _LOOKUP_M
+
         n_bins = int(static.get("_n_bins", 128))
         kk = max(int(static.get("_n_classes", 2)), 2) + 1
+        route = 0.0
         if static.get("_deep"):
             W = int(static["_W"])
             hist = 4.0 * W * d * n_bins * kk * 4
         else:
             depth = int(static.get("_depth", 8))
             hist = 3.0 * (2 ** max(depth - 1, 0)) * d * n_bins * kk * 4
-        return max(1.0, (hist + 4.0 * n * d * 2) / 1e6)
+            # routing compare mask [n, m] (f32 cols + 2 bool masks ~6 B) and
+            # the [n, n_leaves] f32 leaf-sum one-hot (~4 B), m capped at
+            # _LOOKUP_M past which the O(n) gather path takes over
+            m_route = min(2 ** max(depth - 1, 0), _LOOKUP_M)
+            # leaf-sum one-hot only exists when n_leaves fits the lookup
+            # form; past _LOOKUP_M the builder switches to segment_sum
+            m_leaf = 2**depth if 2**depth <= _LOOKUP_M else 0
+            route = 6.0 * n * m_route + 4.0 * n * m_leaf
+        return max(1.0, (hist + route + 4.0 * n * d * 2) / 1e6)
 
     def macs_estimate(self, n, d, static):
         """Histogram-contraction MACs of one (trial, split) fit — used for

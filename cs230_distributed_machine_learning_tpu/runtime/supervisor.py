@@ -149,12 +149,24 @@ class AgentSupervisor:
 
     def stop(self, timeout_s: float = 10.0) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-        for proc in self._procs:
-            if proc is not None and proc.poll() is None:
-                proc.terminate()
         deadline = time.time() + timeout_s
+        # terminate in a loop until the monitor thread is confirmed dead:
+        # a join timeout can leave it mid-iteration, able to _spawn a fresh
+        # child AFTER a single terminate pass — which would leak an
+        # unsupervised agent process (ADVICE r2)
+        while True:
+            for proc in self._procs:
+                if proc is not None and proc.poll() is None:
+                    proc.terminate()
+            if self._thread is None or not self._thread.is_alive():
+                break
+            self._thread.join(timeout=max(0.1, min(2.0, deadline - time.time())))
+            if time.time() >= deadline:
+                # monitor wedged past the budget: sweep once more and move on
+                for proc in self._procs:
+                    if proc is not None and proc.poll() is None:
+                        proc.terminate()
+                break
         for proc in self._procs:
             if proc is None:
                 continue
