@@ -16,9 +16,11 @@ through a fetch-on-miss cache (data/datasets.FetchingDatasetCache): local
 staged copies first, then ``GET /dataset/<id>`` from the coordinator over
 DCN — the replacement for the reference's shared EFS volume
 (docker-compose.yml:92-94), with arrays living in HBM across trials. For
-pod-slice SPMD *within* a job, the agent can be launched under
-``jax.distributed.initialize`` so its mesh spans hosts; the control plane
-here is orthogonal to that data plane.
+pod-slice SPMD *within* a job — chips spread over hosts acting as ONE
+mesh — launch with ``--distributed`` on every host of the slice: process 0
+keeps the whole control plane and every process executes the sharded trial
+batches in lockstep (:func:`run_distributed`;
+parallel/distributed.py has the broadcast/fetch collectives).
 """
 
 from __future__ import annotations
@@ -39,6 +41,31 @@ logger = get_logger("tpuml.agent")
 DEVICE_LOST_EXIT_CODE = 13
 
 
+def _make_executor(url: str, executor_id: str, mesh, max_batch) -> LocalExecutor:
+    """Executor wired the agent way: fetch-on-miss dataset cache so
+    coordinator-staged (kaggle/HF/preprocessed) datasets reach this host
+    over DCN — the shared-volume replacement (VERDICT r1 #4)."""
+    from ..data.datasets import FetchingDatasetCache
+
+    executor = LocalExecutor(
+        executor_id=executor_id, mesh=mesh, cache=FetchingDatasetCache(url)
+    )
+    if max_batch:
+        executor.max_trials_per_batch = max_batch
+    return executor
+
+
+def _exit_for_restart(context: str) -> None:
+    """Fail-fast containment for a poisoned device backend: exit non-zero
+    so a supervisor (runtime/supervisor.py, compose/systemd restart policy)
+    replaces the process with a fresh backend. Pulled tasks stay in the
+    worker's coordinator-side queue and requeue via the dead-worker sweep."""
+    logger.exception("%s; exiting for restart", context)
+    import os
+
+    os._exit(DEVICE_LOST_EXIT_CODE)
+
+
 class WorkerAgent:
     def __init__(
         self,
@@ -51,22 +78,11 @@ class WorkerAgent:
         register_retries: int = 10,
         register_backoff_s: float = 5.0,
     ):
-        from ..data.datasets import FetchingDatasetCache
-
         self.url = coordinator_url.rstrip("/")
         self.poll_timeout_s = poll_timeout_s
         self._stop = threading.Event()
         self.worker_id = self._register(mem_capacity_mb, register_retries, register_backoff_s)
-        # fetch-on-miss dataset cache: coordinator-staged (kaggle/HF/
-        # preprocessed) datasets reach this host over DCN — the shared-volume
-        # replacement (VERDICT r1 #4)
-        self.executor = LocalExecutor(
-            executor_id=self.worker_id,
-            mesh=mesh,
-            cache=FetchingDatasetCache(self.url),
-        )
-        if max_batch:
-            self.executor.max_trials_per_batch = max_batch
+        self.executor = _make_executor(self.url, self.worker_id, mesh, max_batch)
         self._threads: List[threading.Thread] = []
 
     # ---------------- lifecycle ----------------
@@ -130,25 +146,30 @@ class WorkerAgent:
             except Exception:  # noqa: BLE001
                 logger.warning("Heartbeat to %s failed", self.url)
 
-    def _run_loop(self) -> None:
+    def _poll_tasks(self) -> List[Dict[str, Any]]:
+        """One long-poll for this worker's keyed queue; [] on timeout or
+        transient DCN error (backing off inline)."""
         import requests
 
+        try:
+            resp = requests.get(
+                f"{self.url}/next_tasks/{self.worker_id}",
+                params={
+                    "max": self.executor.max_trials_per_batch,
+                    "timeout": self.poll_timeout_s,
+                },
+                timeout=self.poll_timeout_s + 10,
+            )
+            resp.raise_for_status()
+            return resp.json().get("tasks", [])
+        except Exception:  # noqa: BLE001
+            logger.exception("Task poll failed; backing off")
+            time.sleep(1.0)
+            return []
+
+    def _run_loop(self) -> None:
         while not self._stop.is_set():
-            try:
-                resp = requests.get(
-                    f"{self.url}/next_tasks/{self.worker_id}",
-                    params={
-                        "max": self.executor.max_trials_per_batch,
-                        "timeout": self.poll_timeout_s,
-                    },
-                    timeout=self.poll_timeout_s + 10,
-                )
-                resp.raise_for_status()
-                tasks: List[Dict[str, Any]] = resp.json().get("tasks", [])
-            except Exception:  # noqa: BLE001
-                logger.exception("Task poll failed; backing off")
-                time.sleep(1.0)
-                continue
+            tasks = self._poll_tasks()
             if not tasks:
                 continue
             try:
@@ -158,18 +179,9 @@ class WorkerAgent:
                     on_metrics=self._post_metrics,
                 )
             except DeviceLostError:
-                # fail-fast containment: this process's backend is poisoned —
-                # exit non-zero so a supervisor (runtime/supervisor.py, compose
-                # restart policy) replaces the process with a fresh backend.
-                # Pulled tasks stay in this worker's coordinator-side queue and
-                # requeue via the dead-worker sweep.
-                logger.exception(
-                    "Agent %s lost its device backend; exiting for restart",
-                    self.worker_id,
+                _exit_for_restart(
+                    f"Agent {self.worker_id} lost its device backend"
                 )
-                import os
-
-                os._exit(DEVICE_LOST_EXIT_CODE)
 
     def _post_result(self, stid: str, status: str, result: Optional[Dict[str, Any]]) -> None:
         import requests
@@ -196,16 +208,210 @@ class WorkerAgent:
             logger.exception("Metrics post failed")
 
 
+def _prefetch_agree(executor, tasks) -> List[str]:
+    """Pre-collective dataset staging with cross-process agreement.
+
+    The per-rank nondeterminism hazard in SPMD lockstep is the dataset
+    fetch (DCN HTTP): if it failed on only SOME ranks mid-batch, those
+    ranks would skip the batch's collectives while the others entered them
+    — a slice-wide hang. So every rank prefetches each dataset BEFORE the
+    sharded region, then all ranks allgather success flags and agree on
+    the same bad-dataset set. Returns dataset_ids that failed anywhere;
+    tasks on them must be failed host-side (no collectives) on every rank.
+    """
+    import numpy as np
+
+    from jax.experimental import multihost_utils
+
+    from ..models.registry import get_kernel
+
+    wanted: Dict[str, str] = {}  # dataset_id -> model_type (first seen)
+    for st in tasks:
+        wanted.setdefault(st["dataset_id"], st["model_type"])
+    ok = np.zeros((len(wanted),), np.int32)
+    for i, (did, model_type) in enumerate(wanted.items()):
+        try:
+            executor.cache.get(did, get_kernel(model_type).task)
+            ok[i] = 1
+        except Exception:  # noqa: BLE001 — the flag carries the failure
+            logger.exception("Prefetch failed for dataset %r", did)
+    all_ok = np.asarray(multihost_utils.process_allgather(ok))
+    if all_ok.ndim == 1:  # single process
+        all_ok = all_ok[None, :]
+    return [did for i, did in enumerate(wanted) if not all_ok[:, i].all()]
+
+
+def run_distributed(
+    url: str,
+    *,
+    mem_capacity_mb: Optional[float] = None,
+    max_batch: Optional[int] = None,
+    poll_timeout_s: float = 5.0,
+) -> None:
+    """SPMD agent fleet over one multi-process mesh (pod-slice mode).
+
+    Call after :func:`parallel.distributed.init_distributed`. Process 0
+    owns the whole DCN control plane — it registers ONE worker with the
+    coordinator, heartbeats, long-polls tasks, and reports results — while
+    every process (0 included) executes each trial batch over the global
+    mesh built from ``jax.devices()``. Task batches reach the non-primary
+    processes via a host-level broadcast, so all processes enter the same
+    sharded executables in lockstep (the SPMD contract); results are
+    assembled collectively inside the trial engine and only process 0
+    posts them. This is the capability analog of the reference's 4-worker
+    fleet (docker-compose.yml:133-199) rebuilt for hardware where the
+    workers ARE one machine: a v5e-16+ slice whose chips span hosts.
+
+    Shutdown/restart semantics: SIGINT/SIGTERM on process 0 broadcasts a
+    stop message so every rank exits cleanly. A fatal backend fault on any
+    rank exits THAT process non-zero; the peers' next collective then
+    errors (dead peer) and they exit too — restart policy must relaunch
+    the WHOLE slice (one ``jax.distributed`` runtime cannot be rejoined by
+    a lone respawned rank). See deploy/tpu_vm_fleet.md.
+    """
+    import jax
+
+    from ..parallel.distributed import broadcast_json, is_primary
+    from ..parallel.mesh import trial_mesh
+
+    mesh = trial_mesh()  # ALL devices: jax.devices() is global post-init
+    n_proc = jax.process_count()
+    logger.info(
+        "Distributed agent: process %d/%d, %d global devices (%d local)",
+        jax.process_index(), n_proc, len(jax.devices()),
+        len(jax.local_devices()),
+    )
+
+    agent: Optional[WorkerAgent] = None
+    if is_primary():
+        try:
+            agent = WorkerAgent(
+                url,
+                mesh=mesh,
+                mem_capacity_mb=mem_capacity_mb,
+                poll_timeout_s=poll_timeout_s,
+                max_batch=max_batch,
+            )
+        except Exception:
+            # non-primaries are already waiting at their first broadcast:
+            # release them before propagating, or they hang forever
+            logger.exception("Primary registration failed; stopping slice")
+            broadcast_json({"tasks": [], "stop": True})
+            raise
+        # SIGINT/SIGTERM -> graceful slice stop: flag it here, and the loop
+        # below broadcasts {"stop": true} at the next rendezvous so every
+        # rank exits instead of blocking in a collective
+        import signal
+
+        def _on_signal(signum, frame):
+            agent._stop.set()
+
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                signal.signal(sig, _on_signal)
+            except ValueError:  # non-main thread (tests): skip
+                pass
+        hb = threading.Thread(target=agent._heartbeat_loop, daemon=True)
+        hb.start()
+        executor = agent.executor
+        post_result, post_metrics = agent._post_result, agent._post_metrics
+    else:
+        executor = _make_executor(
+            url, f"spmd-rank{jax.process_index()}", mesh, max_batch
+        )
+        post_result = post_metrics = lambda *a, **k: None
+
+    try:
+        while True:
+            if is_primary():
+                stop = agent._stop.is_set()
+                msg = {"tasks": [] if stop else agent._poll_tasks(),
+                       "stop": stop}
+            else:
+                msg = None
+            msg = broadcast_json(msg)  # lockstep rendezvous, every iteration
+            if msg["stop"]:
+                break
+            tasks = msg["tasks"]
+            if not tasks:
+                continue
+            bad = _prefetch_agree(executor, tasks)
+            if bad:
+                # agreed-on unfetchable datasets: fail those tasks without
+                # entering any collective (identical branch on every rank)
+                failed = [t for t in tasks if t["dataset_id"] in bad]
+                tasks = [t for t in tasks if t["dataset_id"] not in bad]
+                for st in failed:
+                    post_result(
+                        st["subtask_id"],
+                        "failed",
+                        {
+                            "subtask_id": st["subtask_id"],
+                            "job_id": st.get("job_id"),
+                            "model_type": st["model_type"],
+                            "parameters": st["parameters"],
+                            "status": "failed",
+                            "error": f"dataset {st['dataset_id']!r} "
+                                     "unavailable on the slice",
+                        },
+                    )
+            if not tasks:
+                continue
+            try:
+                executor.run_subtasks(
+                    tasks, on_result=post_result, on_metrics=post_metrics
+                )
+            except DeviceLostError:
+                _exit_for_restart(
+                    f"SPMD rank {jax.process_index()} lost its backend"
+                )
+    except KeyboardInterrupt:
+        if agent is not None:
+            agent._stop.set()
+    finally:
+        if agent is not None:
+            agent.stop()
+
+
 def main() -> None:
     """CLI: ``python -m cs230_distributed_machine_learning_tpu.runtime.agent
-    --url http://coordinator:5001`` (one per TPU-VM host)."""
+    --url http://coordinator:5001`` (one per TPU-VM host).
+
+    Pod-slice SPMD (chips spanning hosts acting as one mesh): add
+    ``--distributed`` on EVERY host of the slice. On TPU VMs the topology
+    flags are optional (inferred from TPU metadata); on CPU test fleets
+    pass ``--coordinator-address host:port --num-processes N
+    --process-id i`` (and optionally ``--local-devices K`` for K virtual
+    devices per process)."""
     import argparse
 
     parser = argparse.ArgumentParser(description="tpuml worker agent")
     parser.add_argument("--url", required=True, help="coordinator base URL")
     parser.add_argument("--mem-mb", type=float, default=None)
     parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--distributed", action="store_true",
+                        help="join a jax.distributed multi-process mesh")
+    parser.add_argument("--coordinator-address", default=None,
+                        help="jax.distributed rendezvous host:port "
+                             "(NOT the REST url; optional on TPU VMs)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--local-devices", type=int, default=None,
+                        help="virtual device count per process (CPU testing)")
     args = parser.parse_args()
+    if args.distributed:
+        from ..parallel.distributed import init_distributed
+
+        init_distributed(
+            args.coordinator_address,
+            args.num_processes,
+            args.process_id,
+            local_device_count=args.local_devices,
+        )
+        run_distributed(
+            args.url, mem_capacity_mb=args.mem_mb, max_batch=args.max_batch
+        )
+        return
     agent = WorkerAgent(args.url, mem_capacity_mb=args.mem_mb, max_batch=args.max_batch)
     agent.run_forever()
 

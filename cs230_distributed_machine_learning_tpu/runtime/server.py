@@ -230,19 +230,32 @@ def create_app(coordinator: Optional[Coordinator] = None):
 
     handlers = locals()
 
+    # CORS parity with the reference master's flask-cors default config
+    # (allow-all; master.py:20-24): browser dashboards may call the API
+    # cross-origin, including OPTIONS preflights
+    _cors = {
+        "Access-Control-Allow-Origin": "*",
+        "Access-Control-Allow-Headers": "Content-Type, Authorization",
+        "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+    }
+
     @Request.application
     def app(request):
+        if request.method == "OPTIONS":
+            return Response(status=204, headers=_cors)
         try:
             endpoint, values = url_map.bind_to_environ(request.environ).match()
-            return handlers[endpoint](request, **values)
+            resp = handlers[endpoint](request, **values)
         except NotFound:
-            return _json({"status": "error", "message": "not found"}, status=404)
+            resp = _json({"status": "error", "message": "not found"}, status=404)
         except HTTPException as e:
-            return _json({"status": "error", "message": str(e)}, status=e.code or 500)
+            resp = _json({"status": "error", "message": str(e)}, status=e.code or 500)
         except (KeyError, FileNotFoundError) as e:
-            return _json({"status": "error", "message": str(e)}, status=404)
+            resp = _json({"status": "error", "message": str(e)}, status=404)
         except Exception as e:  # noqa: BLE001
-            return _json({"status": "error", "message": str(e)}, status=500)
+            resp = _json({"status": "error", "message": str(e)}, status=500)
+        resp.headers.extend(_cors)
+        return resp
 
     app.coordinator = coord
     return app

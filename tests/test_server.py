@@ -40,6 +40,18 @@ def test_home_enumerates_routes(client):
     assert client.get("/health").get_json()["status"] == "ok"
 
 
+def test_cors_headers(client):
+    """Allow-all CORS parity with the reference master's flask-cors setup
+    (master.py:20-24): every response carries the origin header and OPTIONS
+    preflights succeed without hitting a handler."""
+    assert client.get("/health").headers["Access-Control-Allow-Origin"] == "*"
+    # errors carry it too (a browser can read the error body)
+    assert client.get("/nope").headers["Access-Control-Allow-Origin"] == "*"
+    pre = client.open("/train/abc", method="OPTIONS")
+    assert pre.status_code == 204
+    assert "POST" in pre.headers["Access-Control-Allow-Methods"]
+
+
 def test_full_rest_train_flow(client):
     sid = _session(client)
     # check_data on a builtin stages lazily -> initially absent is fine
