@@ -47,14 +47,28 @@ def init_distributed(
     enabled automatically.
     """
     import os
+    import re
 
     if local_device_count:
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{local_device_count}"
-            ).strip()
+        flag = f"--xla_force_host_platform_device_count={local_device_count}"
+        if "xla_force_host_platform_device_count" in flags:
+            # an inherited flag (test harnesses export =8) must not silently
+            # win over the explicit request — mismatched per-rank device
+            # counts would corrupt the global mesh topology
+            new_flags = re.sub(
+                r"--?xla_force_host_platform_device_count=\d+", flag, flags
+            )
+            if new_flags != flags:
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "overriding inherited xla_force_host_platform_device_count"
+                    " with --local-devices=%d", local_device_count,
+                )
+            os.environ["XLA_FLAGS"] = new_flags
+        else:
+            os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()
 
     import jax
 

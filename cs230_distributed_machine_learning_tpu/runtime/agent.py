@@ -228,17 +228,28 @@ def _prefetch_agree(executor, tasks) -> List[str]:
     wanted: Dict[str, str] = {}  # dataset_id -> model_type (first seen)
     for st in tasks:
         wanted.setdefault(st["dataset_id"], st["model_type"])
-    ok = np.zeros((len(wanted),), np.int32)
+    # signature per dataset, not just a success bit: a rank whose DCN fetch
+    # fell back to a stale local copy would report ok yet stage different-
+    # shaped arrays — mismatched executables across the slice. (rows, cols)
+    # agreement catches the version split; (0, 0) marks outright failure.
+    sig = np.zeros((len(wanted), 2), np.int64)
     for i, (did, model_type) in enumerate(wanted.items()):
         try:
-            executor.cache.get(did, get_kernel(model_type).task)
-            ok[i] = 1
-        except Exception:  # noqa: BLE001 — the flag carries the failure
+            data = executor.cache.get(did, get_kernel(model_type).task)
+            sig[i] = data.X.shape[:2]
+        except Exception:  # noqa: BLE001 — the zero signature carries it
             logger.exception("Prefetch failed for dataset %r", did)
-    all_ok = np.asarray(multihost_utils.process_allgather(ok))
-    if all_ok.ndim == 1:  # single process
-        all_ok = all_ok[None, :]
-    return [did for i, did in enumerate(wanted) if not all_ok[:, i].all()]
+    all_sig = np.asarray(multihost_utils.process_allgather(sig))
+    if all_sig.ndim == 2:  # single process
+        all_sig = all_sig[None, :, :]
+    bad = []
+    for i, did in enumerate(wanted):
+        rank_sigs = all_sig[:, i, :]
+        if (rank_sigs == 0).all(axis=1).any() or len(
+            {tuple(s) for s in rank_sigs}
+        ) > 1:
+            bad.append(did)
+    return bad
 
 
 def run_distributed(

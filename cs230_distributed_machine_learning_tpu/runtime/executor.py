@@ -13,6 +13,7 @@ engine's runtime predictor) are drop-in.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -27,6 +28,85 @@ logger = get_logger("tpuml.executor")
 
 ResultCallback = Callable[[str, str, Optional[Dict[str, Any]]], None]
 MetricsCallback = Callable[[Dict[str, Any]], None]
+
+
+class ResourceSampler:
+    """Background CPU/mem sampling at a fixed cadence DURING a fit.
+
+    The reference samples psutil every 0.5 s in a thread while the sklearn
+    fit runs and reports the averages (worker.py:201-221, 240-241); those
+    averages are two of the runtime predictor's 7 features, so a single
+    instantaneous snapshot (the round-2 form) fed it near-noise. Also
+    tracks device-memory stats (peak bytes in use across samples) — the
+    accelerator-side resource signal psutil can't see.
+    """
+
+    def __init__(self, interval_s: float = 0.5):
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._cpu: List[float] = []
+        self._mem: List[float] = []
+        self._dev_peak_mb: Optional[float] = None
+
+    def _sample_device(self) -> None:
+        # max over CURRENT bytes_in_use samples: this fit's observed peak.
+        # (peak_bytes_in_use is monotonic over the backend's lifetime — it
+        # would report the largest batch ever, not this one)
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats() or {}
+            used = stats.get("bytes_in_use")
+            if used is not None:
+                mb = used / 1e6
+                if self._dev_peak_mb is None or mb > self._dev_peak_mb:
+                    self._dev_peak_mb = mb
+        except Exception:  # noqa: BLE001 — stats are best-effort (cpu backend)
+            pass
+
+    def _loop(self) -> None:
+        try:
+            import psutil
+        except ImportError:
+            return
+        psutil.cpu_percent(interval=None)  # prime the delta-based counter
+        while not self._stop.wait(self.interval_s):
+            self._cpu.append(psutil.cpu_percent(interval=None))
+            self._mem.append(psutil.virtual_memory().percent)
+            self._sample_device()
+
+    def __enter__(self) -> "ResourceSampler":
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval_s + 1)
+        self._sample_device()  # at least one device reading even on fast fits
+
+    def averages(self) -> Dict[str, Optional[float]]:
+        """Averaged samples; falls back to one instantaneous reading when
+        the fit finished inside the first sampling interval."""
+        cpu = mem = None
+        if self._cpu:
+            cpu = float(sum(self._cpu) / len(self._cpu))
+            mem = float(sum(self._mem) / len(self._mem))
+        else:
+            try:
+                import psutil
+
+                cpu = psutil.cpu_percent(interval=None)
+                mem = psutil.virtual_memory().percent
+            except ImportError:
+                pass
+        return {
+            "cpu_percent_avg": cpu,
+            "mem_percent_avg": mem,
+            "device_peak_mem_mb": self._dev_peak_mb,
+        }
 
 
 class LocalExecutor:
@@ -87,7 +167,7 @@ class LocalExecutor:
                 )
                 started_at = time.time()
                 profiler_cm = self._profiler_cm(model_type)
-                with profiler_cm:
+                with profiler_cm, ResourceSampler() as sampler:
                     run = run_trials(
                         kernel,
                         data,
@@ -98,6 +178,7 @@ class LocalExecutor:
                         max_trials_per_batch=self.max_trials_per_batch,
                     )
                 finished_at = time.time()
+                resources = sampler.averages()
                 per_trial_time = run.run_time_s / max(len(idxs), 1)
                 for j, gi in enumerate(idxs):
                     st = subtasks[gi]
@@ -117,7 +198,8 @@ class LocalExecutor:
                     if on_metrics:
                         on_metrics(
                             self._metrics_message(
-                                st, received_at, started_at, finished_at, model_type
+                                st, received_at, started_at, finished_at,
+                                model_type, resources,
                             )
                         )
             except Exception as e:  # noqa: BLE001 — task-level failure semantics
@@ -166,17 +248,13 @@ class LocalExecutor:
             "fitted_params": fitted,
         }
 
-    def _metrics_message(self, st, received_at, started_at, finished_at, algo):
-        """Reference metrics schema (worker.py:233-243) + device info; CPU/mem
-        via psutil when available, matching the reference's sampler."""
-        cpu = mem = None
-        try:
-            import psutil
-
-            cpu = psutil.cpu_percent(interval=None)
-            mem = psutil.virtual_memory().percent
-        except ImportError:
-            pass
+    def _metrics_message(self, st, received_at, started_at, finished_at,
+                         algo, resources=None):
+        """Reference metrics schema (worker.py:233-243): CPU/mem averaged
+        over the fit by the 0.5 s-cadence ResourceSampler (the predictor's
+        feature inputs), plus device peak-memory — the accelerator signal
+        the reference had no analog for."""
+        resources = resources or {}
         return {
             "worker_id": self.executor_id,
             "subtask_id": st["subtask_id"],
@@ -184,8 +262,9 @@ class LocalExecutor:
             "received_at": received_at,
             "started_at": started_at,
             "finished_at": finished_at,
-            "cpu_percent_avg": cpu,
-            "mem_percent_avg": mem,
+            "cpu_percent_avg": resources.get("cpu_percent_avg"),
+            "mem_percent_avg": resources.get("mem_percent_avg"),
+            "device_peak_mem_mb": resources.get("device_peak_mem_mb"),
             "algo": algo,
         }
 

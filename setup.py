@@ -15,6 +15,9 @@ setup(
         "pandas",
         "scikit-learn",
         "pyyaml",
+        # in-fit resource sampling (runtime/executor.ResourceSampler) feeds
+        # the runtime predictor's cpu/mem features
+        "psutil",
     ],
     extras_require={
         "client": ["requests", "tqdm"],

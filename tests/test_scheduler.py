@@ -28,6 +28,169 @@ def _task(stid, mem=1.0):
     return {"subtask_id": stid, "model_type": "LogisticRegression", "mem_estimate_mb": mem}
 
 
+class _RecordingBus(TopicBus):
+    """Bus that records publishes so dropped-task routing is observable."""
+
+    def __init__(self):
+        super().__init__()
+        self.published = []
+
+    def publish(self, topic, message, key=None):
+        self.published.append((topic, message, key))
+        return super().publish(topic, message, key=key)
+
+
+def _check_invariants(eng, model_inflight, completed, dropped_ids):
+    """The safety properties of the placement state machine (SURVEY §5.2
+    obligation; hazard source: the reference's unsynchronized mutation of
+    Scheduler.workers, scheduler_service.py:205-293):
+
+    - bookkeeping balanced: each worker's load/mem equals the sum of its
+      queued tasks' recorded estimates; never negative
+    - no duplicate ownership: a subtask sits in at most one queue
+    - nothing lost: every placed-and-unfinished task is owned by a live
+      worker or was explicitly dropped to the tasks topic
+    - speed factor stays inside the configured clamp
+    """
+    from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+    cfg = get_config().scheduler
+    owned = {}
+    with eng._lock:
+        for wid, w in eng.workers.items():
+            assert w.load_seconds >= 0.0, (wid, w.load_seconds)
+            assert w.mem_load_mb >= 0.0, (wid, w.mem_load_mb)
+            q_ids = [t["subtask_id"] for t in w.tasks_queue]
+            assert len(q_ids) == len(set(q_ids)), f"{wid} queue has dupes"
+            assert set(q_ids) == set(w.task_est) == set(w.task_mem), (
+                wid, q_ids, list(w.task_est), list(w.task_mem))
+            assert w.load_seconds == pytest.approx(sum(w.task_est.values()))
+            assert w.mem_load_mb == pytest.approx(sum(w.task_mem.values()))
+            assert cfg.speed_factor_min <= w.speed_factor <= cfg.speed_factor_max
+            for stid in q_ids:
+                assert stid not in owned, f"{stid} owned by {owned[stid]} and {wid}"
+                owned[stid] = wid
+    for stid in model_inflight:
+        assert stid in owned or stid in completed or stid in dropped_ids, (
+            f"task {stid} lost: not owned, not completed, not dropped")
+
+
+def test_property_random_interleavings():
+    """Seeded random walks over subscribe/place/metrics/sweep/unsubscribe/
+    heartbeat-expiry; invariants checked after every step (VERDICT r2 #8).
+
+    Includes the adversarial inputs the example tests don't reach:
+    metrics attributed to the wrong worker, duplicate completions,
+    completions for never-placed ids, sweeps with every worker expired,
+    and requeue cascades from chained unsubscribes."""
+    import random
+
+    from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+    cfg = get_config().scheduler
+    for seed in range(20):
+        rng = random.Random(seed)
+        bus = _RecordingBus()
+        eng = PlacementEngine(bus=bus, predictor=FixedPredictor(est=rng.uniform(0.5, 20)))
+        inflight = {}  # stid -> placed worker (at placement time)
+        completed = set()
+        next_task = [0]
+
+        def new_task():
+            stid = f"t{next_task[0]}"
+            next_task[0] += 1
+            return _task(stid, mem=rng.choice([0.5, 1.0, 50.0, 5000.0]))
+
+        ops = ["subscribe", "place", "complete", "wrong_metrics",
+               "dup_metrics", "ghost_metrics", "unsubscribe", "expire_sweep",
+               "heartbeat"]
+        for _ in range(120):
+            op = rng.choice(ops)
+            with eng._lock:
+                wids = list(eng.workers)
+            if op == "subscribe":
+                eng.subscribe(mem_capacity_mb=rng.choice([10.0, 100.0, 16000.0]))
+            elif op == "place":
+                t = new_task()
+                wid = eng.place(t)
+                if wid is not None:
+                    inflight[t["subtask_id"]] = wid
+                # wid None (no workers): task never entered the machine
+            elif op == "complete" and inflight:
+                stid = rng.choice(sorted(inflight))
+                owner = None
+                for wid, q in eng.queue_snapshot().items():
+                    if stid in q:
+                        owner = wid
+                if owner is not None:
+                    t0 = time.time()
+                    eng.on_metrics({
+                        "worker_id": owner, "subtask_id": stid,
+                        "started_at": t0 - rng.uniform(0.01, 30), "finished_at": t0,
+                    })
+                    completed.add(stid)
+                    del inflight[stid]
+            elif op == "wrong_metrics" and inflight and wids:
+                # metrics blaming a worker that does NOT own the task must
+                # not corrupt anyone's books
+                stid = rng.choice(sorted(inflight))
+                owner = {s: w for w, q in eng.queue_snapshot().items()
+                         for s in q}.get(stid)
+                others = [w for w in wids if w != owner]
+                if others:
+                    t0 = time.time()
+                    eng.on_metrics({
+                        "worker_id": rng.choice(others), "subtask_id": stid,
+                        "started_at": t0 - 1, "finished_at": t0,
+                    })
+            elif op == "dup_metrics" and completed and wids:
+                t0 = time.time()
+                eng.on_metrics({
+                    "worker_id": rng.choice(wids),
+                    "subtask_id": rng.choice(sorted(completed)),
+                    "started_at": t0 - 1, "finished_at": t0,
+                })
+            elif op == "ghost_metrics" and wids:
+                t0 = time.time()
+                eng.on_metrics({
+                    "worker_id": rng.choice(wids), "subtask_id": "never-placed",
+                    "started_at": t0 - 1, "finished_at": t0,
+                })
+            elif op == "unsubscribe" and wids:
+                eng.unsubscribe(rng.choice(wids))
+            elif op == "expire_sweep" and wids:
+                expire = rng.sample(wids, rng.randint(1, len(wids)))
+                with eng._lock:
+                    for wid in expire:
+                        if wid in eng.workers:
+                            eng.workers[wid].last_heartbeat = (
+                                time.time() - cfg.dead_after_s - 1)
+                eng.sweep()
+            elif op == "heartbeat" and wids:
+                eng.heartbeat(rng.choice(wids))
+
+            dropped = {m["subtask_id"] for topic, m, _ in bus.published
+                       if topic == "tasks"}
+            _check_invariants(eng, inflight, completed, dropped)
+
+        # terminal drain: bring one fresh worker up and complete everything
+        # still owned — no task may be stuck unowned yet undropped
+        eng.subscribe(mem_capacity_mb=1e9)
+        dropped = {m["subtask_id"] for topic, m, _ in bus.published
+                   if topic == "tasks"}
+        for wid, q in eng.queue_snapshot().items():
+            for stid in list(q):
+                t0 = time.time()
+                eng.on_metrics({"worker_id": wid, "subtask_id": stid,
+                                "started_at": t0 - 1, "finished_at": t0})
+                completed.add(stid)
+                inflight.pop(stid, None)
+        for stid in list(inflight):
+            assert stid in dropped or stid in completed, (
+                f"seed {seed}: task {stid} leaked")
+        _check_invariants(eng, inflight, completed, dropped)
+
+
 def test_ids_are_monotonic_and_elastic():
     eng = PlacementEngine(predictor=FixedPredictor())
     w0 = eng.subscribe()
