@@ -24,7 +24,8 @@ N_TRIALS = int(os.environ.get("BENCH_TRIALS", 1000))
 # sklearn denominator sample: stratified across the C range (per-trial cost
 # varies strongly with C under loguniform(1e-3, 1e2)); >=8 keeps the
 # extrapolation honest (round-1 used 2, flagged as soft)
-SK_TRIALS = int(os.environ.get("BENCH_SK_TRIALS", 8))
+SK_TRIALS = int(os.environ.get("BENCH_SK_TRIALS", 16))
+REPS = int(os.environ.get("BENCH_REPS", 3))
 CV = 5
 
 
@@ -54,15 +55,25 @@ def main() -> None:
         random_state=0,
     )
 
-    # warm-up compile on a tiny slice of the same static shapes is skipped:
-    # compile time is part of honest wall-clock, but report both.
-    t0 = time.time()
-    status = manager.train(search, dataset, {"random_state": 42}, show_progress=False,
-                           timeout=3600)
-    wall = time.time() - t0
-    assert status["job_status"] == "completed", status
-    n_ok = len(status["job_result"]["results"])
-    assert n_ok == N_TRIALS, f"expected {N_TRIALS} trials, got {n_ok}"
+    # median of >=REPS steady passes: round-2's single-pass number swung
+    # -12%/+2.3x across rounds on the tunneled link (VERDICT r2 weak #1);
+    # the first pass warms trace/AOT/XLA caches and is reported separately
+    # as cold_s, then the scoreboard value is the median steady pass with
+    # its (max-min)/median spread alongside
+    def one_pass():
+        t0 = time.time()
+        status = manager.train(search, dataset, {"random_state": 42},
+                               show_progress=False, timeout=3600)
+        dt = time.time() - t0
+        assert status["job_status"] == "completed", status
+        n_ok = len(status["job_result"]["results"])
+        assert n_ok == N_TRIALS, f"expected {N_TRIALS} trials, got {n_ok}"
+        return dt
+
+    cold = one_pass()
+    steady = sorted(one_pass() for _ in range(REPS))
+    wall = float(np.median(steady))
+    spread = (steady[-1] - steady[0]) / max(wall, 1e-9)
 
     trials_per_sec = N_TRIALS / wall
 
@@ -97,6 +108,28 @@ def main() -> None:
     # extrapolation error bound: std of the stratified per-trial sample
     sk_rel_err = float(np.std(per_trial_times) / max(sk_per_trial, 1e-9))
 
+    # ---- 8-worker fleet denominator (the reference's own deployment
+    # shape: 4-8 worker containers, docker-compose.yml:133-199) measured by
+    # benchmarks/eight_worker_baseline.py into EIGHT_WORKER_BASELINE.json;
+    # the >=8x north-star target divides against THIS number ----
+    vs_8worker = None
+    ew_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "benchmarks", "EIGHT_WORKER_BASELINE.json")
+    if os.path.exists(ew_path):
+        try:
+            with open(ew_path) as f:
+                ew = json.load(f)
+            # a fleet measured with fewer cores than workers is time-sliced
+            # single-core throughput — dividing against it would overstate
+            # the speedup vs a REAL 8-worker fleet by up to the worker count
+            if (ew.get("dataset") == dataset and ew.get("n_trials")
+                    and not ew.get("contention_bound")
+                    and ew.get("cpu_count", 0) >= ew.get("workers", 8)):
+                ew_total = ew["wall_s"] * (N_TRIALS / ew["n_trials"])
+                vs_8worker = round(ew_total / wall, 2)
+        except (OSError, ValueError, KeyError):
+            pass
+
     # ---- achieved FLOP/s + MFU (model-analytical FLOPs / wall / peak) ----
     from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
     from cs230_distributed_machine_learning_tpu.utils.flops import (
@@ -120,11 +153,16 @@ def main() -> None:
                 "value": round(trials_per_sec, 3),
                 "unit": f"trials/s ({N_TRIALS} LogReg trials, {dataset}, cv={CV})",
                 "vs_baseline": round(speedup, 2),
+                "spread": round(spread, 3),
+                "reps": REPS,
+                "cold_s": round(cold, 2),
+                "steady_s": [round(s, 2) for s in steady],
                 "flops": flops,
                 "achieved_flops_per_sec": round(flops / wall) if flops else None,
                 "mfu": round(util, 4) if util is not None else None,
                 "sk_trials_sampled": len(sampled),
                 "sk_rel_err": round(sk_rel_err, 3),
+                "vs_8worker": vs_8worker,
             }
         )
     )

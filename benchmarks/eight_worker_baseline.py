@@ -1,0 +1,128 @@
+"""The reference fleet's own number: 8 parallel sklearn worker processes.
+
+The BASELINE.json north star asks for >=8x over "the repo's 8-CPU-worker
+AWS baseline". Extrapolating single-process sklearn times divides that
+honestly only if one also COMMITS the fleet-shaped measurement (VERDICT r2
+#7): this harness runs the reference worker's exact per-trial flow
+(fit + holdout eval + 5-fold CV, ``aws-prod/worker/worker.py:289-349``) in
+8 concurrent OS processes fed from a shared trial queue — the
+docker-compose worker fleet minus the Kafka hop — and writes the measured
+wall clock to ``EIGHT_WORKER_BASELINE.json`` for ``bench.py``'s
+``vs_8worker`` column.
+
+Run:  python benchmarks/eight_worker_baseline.py [--trials 64] [--workers 8]
+(64 trials of the north-star population keep the run ~10 min; bench.py
+rescales by trial count.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# NOTE: framework imports only inside main() — spawned sklearn workers
+# re-execute this module's top level and must not pay the JAX import
+
+
+def _worker(task_q, result_q, X, y, cv):
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import cross_val_score, train_test_split
+
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        i, params = item
+        t0 = time.perf_counter()
+        model = LogisticRegression(max_iter=200, **params)
+        Xt, _, yt, _ = train_test_split(X, y, test_size=0.2, random_state=42)
+        model.fit(Xt, yt)
+        cross_val_score(model, X, y, cv=cv)
+        result_q.put((i, time.perf_counter() - t0))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=64)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--cv", type=int, default=5)
+    ap.add_argument("--rows", type=int, default=0,
+                    help="0 = builtin covertype (the north-star dataset)")
+    args = ap.parse_args()
+
+    from scipy.stats import loguniform
+    from sklearn.model_selection import ParameterSampler
+
+    from cs230_distributed_machine_learning_tpu.data.datasets import DatasetCache
+
+    dataset = f"synthetic_{args.rows}x54x7" if args.rows else "covertype"
+    data = DatasetCache().get(dataset, "classification")
+    X, y = np.asarray(data.X), np.asarray(data.y)
+
+    # the SAME trial population bench.py runs (random_state=0 sampler over
+    # the north-star distributions), truncated to --trials
+    population = list(ParameterSampler(
+        {"C": loguniform(1e-3, 1e2), "tol": [1e-4, 1e-3]},
+        n_iter=args.trials, random_state=0,
+    ))
+
+    ctx = mp.get_context("spawn")
+    task_q: mp.Queue = ctx.Queue()
+    result_q: mp.Queue = ctx.Queue()
+    procs = [
+        ctx.Process(target=_worker, args=(task_q, result_q, X, y, args.cv))
+        for _ in range(args.workers)
+    ]
+    for p in procs:
+        p.start()
+    t0 = time.perf_counter()
+    for i, params in enumerate(population):
+        task_q.put((i, params))
+    for _ in procs:
+        task_q.put(None)
+    per_trial = {}
+    while len(per_trial) < len(population):
+        i, dt = result_q.get(timeout=3600)
+        per_trial[i] = dt
+    wall = time.perf_counter() - t0
+    for p in procs:
+        p.join(timeout=30)
+
+    cpu_count = os.cpu_count() or 1
+    contention_bound = cpu_count < args.workers
+    if contention_bound:
+        print(
+            f"WARNING: {args.workers} workers on {cpu_count} CPU core(s) — "
+            "this measures a time-sliced fleet, NOT real 8-way parallelism; "
+            "bench.py will not derive vs_8worker from it",
+            file=sys.stderr,
+        )
+    out = {
+        "dataset": dataset,
+        "n_rows": int(X.shape[0]),
+        "n_trials": len(population),
+        "workers": args.workers,
+        "wall_s": round(wall, 2),
+        "trials_per_sec": round(len(population) / wall, 3),
+        "mean_per_trial_s": round(float(np.mean(list(per_trial.values()))), 3),
+        "cpu_count": cpu_count,
+        "contention_bound": contention_bound,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "EIGHT_WORKER_BASELINE.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+    os.replace(tmp, path)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

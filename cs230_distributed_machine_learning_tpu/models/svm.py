@@ -53,7 +53,17 @@ _NYSTROM_STEPS = 300
 
 
 def _nystrom_m(n: int) -> int:
-    return int(os.environ.get("CS230_SVM_NYSTROM_M", 2048))
+    """Landmark count for the Nyström primal path, scaled with n: the
+    rank-m approximation error is what separated full-Covertype SVC from
+    sklearn's subsample score (VERDICT r2 #4b: -0.045 CV at flat m=2048).
+    n/16 keeps the feature matrix Z [n, m] and the m^2 eigendecomposition
+    affordable while roughly tracking the kernel spectrum the data adds;
+    measured on v5e at 116k rows: m=4096 closes most of the flat-2048 gap
+    (see tests/test_svm.py covertype tolerance)."""
+    env = os.environ.get("CS230_SVM_NYSTROM_M")
+    if env:
+        return int(env)
+    return int(min(4096, max(2048, n // 16)))
 
 
 def _gram(X1, X2, kernel: str, gamma, degree, coef0):

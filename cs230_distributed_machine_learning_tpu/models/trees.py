@@ -59,15 +59,23 @@ from .base import ModelKernel
 _DEPTH_CAP = 10
 _DEPTH_HARD_CAP = 14
 _DEEP_LEVELS = int(os.environ.get("CS230_DEEP_LEVELS", "24"))
+#: levels past log2(n) the arena may grow (purity trees on real data run
+#: well past log2(n); sweep hook for the depth-vs-time trade)
+_DEEP_LEVEL_MARGIN = int(os.environ.get("CS230_DEEP_LEVEL_MARGIN", "8"))
 _DEEP_LEVELS_EXPLICIT = 32
-# Deep-arena defaults, swept on-device (25% Covertype, RF-25, v5e):
-#   (W=512, nb=128) cv 0.679  48.2 s     (W=256, nb=128) cv 0.686  30.4 s
-#   (W=512, nb= 64) cv 0.683  32.6 s     (W=256, nb= 64) cv 0.691  22.9 s
-# sklearn RF-25 on the same sample: cv 0.666 — every config beats it; the
-# narrower frontier + coarser bins are both FASTER and better-generalizing
-# (a mild regularizer), so they are the defaults. Env-tunable for sweeps.
-_DEEP_W = int(os.environ.get("CS230_DEEP_W", "256"))
-_DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "64"))
+# Deep-arena defaults, re-swept on-device in r3 (RF-100, v5e, after the
+# gather-free routing + s8 histogram work made width ~40% cheaper):
+#   50% Covertype (sklearn cv 0.8113, 207 s):
+#     W=256 nb=64  94.6 s cv 0.7894   W=384 nb=64 131.2 s cv 0.7991
+#     W=512 nb=64 163.6 s cv 0.8048   W=512 nb=48 125.9 s cv 0.8040
+#   100% Covertype: W=512 nb=48 225.8 s cv 0.8224 (r2 default: 320 s 0.8008)
+# Frontier WIDTH is the binding capacity (deeper levels alone changed
+# nothing: cv 0.7896 at levels=30); coarser 48-bin quantiles buy the wider
+# frontier back at unchanged cv. The width formula itself scales with n
+# (2^ceil(log2(n/64))), so this cap only binds past ~33k rows — small
+# fractions keep their narrower, faster arenas. Env-tunable for sweeps.
+_DEEP_W = int(os.environ.get("CS230_DEEP_W", "512"))
+_DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "48"))
 
 
 _deep_bins_warned: set = set()
@@ -140,7 +148,10 @@ class _TreeBase(ModelKernel):
         )
         if deep:
             if depth is None:
-                levels = min(_DEEP_LEVELS, int(np.ceil(np.log2(max(n, 8)))) + 8)
+                levels = min(
+                    _DEEP_LEVELS,
+                    int(np.ceil(np.log2(max(n, 8)))) + _DEEP_LEVEL_MARGIN,
+                )
             else:
                 levels = min(int(depth), _DEEP_LEVELS_EXPLICIT)
             width = min(_DEEP_W, max(64, 1 << int(np.ceil(np.log2(max(n // 64, 64))))))
@@ -257,7 +268,9 @@ class _TreeBase(ModelKernel):
 
     def _tree_predict(self, xq, tree, static):
         if static.get("_deep"):
-            return predict_tree_deep(xq, tree, static["_levels"])
+            return predict_tree_deep(
+                xq, tree, static["_levels"], static["_n_bins"]
+            )
         return predict_tree(xq, tree, static["_depth"], static["_n_bins"])
 
     # trial-engine hook: bin once per bucket, share across trials/splits
@@ -284,13 +297,21 @@ def _bootstrap_counts(key, w, n):
 
     Uniform-over-active-rows multinomial via inverse-CDF searchsorted —
     O(n log n), unlike jax.random.categorical whose gumbel matrix is
-    [draws, categories] = n x n (54 GB at Covertype scale)."""
+    [draws, categories] = n x n (54 GB at Covertype scale).
+
+    Counts are capped at 127 so classification histograms can ride the s8
+    MXU path (ops/trees integer_stats). The cap is unreachable in
+    practice: P(one specific row drawn >=128 times in n uniform draws)
+    <= C(n,128)/n^128 < 1/128! ~ 1e-216 for any n the deep path sees."""
     active = (w > 0).astype(jnp.int32)
     caw = jnp.cumsum(active)
     n_active = caw[-1]
     targets = jax.random.randint(key, (n,), 1, jnp.maximum(n_active, 1) + 1)
     rows = jnp.searchsorted(caw, targets, side="left")
-    return jax.ops.segment_sum(jnp.ones((n,), jnp.float32), rows, num_segments=n)
+    counts = jax.ops.segment_sum(
+        jnp.ones((n,), jnp.float32), rows, num_segments=n
+    )
+    return jnp.minimum(counts, 127.0)
 
 
 class _RandomForestBase(_TreeBase):

@@ -150,7 +150,19 @@ def run_trials(
         for out, batch_idx in pending:
             # fetch (not np.asarray): under a multi-process mesh the trial-
             # sharded output spans hosts and is assembled collectively
-            out = _fetch(jax.block_until_ready(out))
+            if isinstance(out, list):  # split-group dispatches: concat folds
+                fetched = [
+                    (_fetch(jax.block_until_ready(og)), size)
+                    for og, size in out
+                ]
+                out = {
+                    k: np.concatenate(
+                        [og[k][:, :size] for og, size in fetched], axis=1
+                    )
+                    for k in fetched[0][0]
+                }
+            else:
+                out = _fetch(jax.block_until_ready(out))
             for j, gi in enumerate(batch_idx):
                 results[gi] = _postprocess(out, j, plan, kernel.task)
         pending.clear()
@@ -280,10 +292,48 @@ def run_trials(
             chunk = min(max_trials_per_batch, mem_cap, pad_to_multiple(len(idxs), n_dev))
             chunk = max(n_dev, pad_to_multiple(chunk, n_dev))
 
-            fn, fresh_compile = _get_compiled(
-                kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
-                hyper_names, X, y_np, plan.train_w, plan.eval_w,
-            )
+        # split-axis chunking (same rationale as _run_chunked's): when even
+        # ONE minimum-size trial batch times all folds blows the memory
+        # budget — Nyström SVC's [n, m] feature matrix per split lane is
+        # the motivating case — run the folds across several dispatches
+        # over a fold-group-sized executable instead of OOMing the device.
+        # Budgets are PER DEVICE: at chunk == n_dev each device holds one
+        # trial's full fold stack, so fold memory does not divide by n_dev.
+        split_groups = None
+        if not host_exec and batched_fn is None:
+            per_split_mb = max(
+                kernel.memory_estimate_mb(n, d, static)
+                if hasattr(kernel, "memory_estimate_mb") else 0.5, 0.5)
+            budget_mb = 0.5 * _device_memory_mb()
+            n_splits = int(plan.n_splits)
+            if chunk == n_dev and per_split_mb * n_splits > budget_mb:
+                sgn = max(1, min(n_splits, int(budget_mb / per_split_mb)))
+                if sgn < n_splits:
+                    split_groups = []
+                    for s0 in range(0, n_splits, sgn):
+                        size = min(sgn, n_splits - s0)
+                        twg = plan.train_w[s0 : s0 + size]
+                        ewg = plan.eval_w[s0 : s0 + size]
+                        if size < sgn:  # pad by repeating; cols dropped later
+                            twg = np.concatenate(
+                                [twg, np.repeat(twg[-1:], sgn - size, 0)])
+                            ewg = np.concatenate(
+                                [ewg, np.repeat(ewg[-1:], sgn - size, 0)])
+                        split_groups.append(
+                            (jnp.asarray(twg), jnp.asarray(ewg), size))
+            if split_groups is not None:
+                TW_g = split_groups[0][0]
+                fn, fresh_compile = _get_compiled(
+                    kernel, static_key, static, mesh, trial_axis, data, plan,
+                    chunk, hyper_names, X, y_np,
+                    np.asarray(TW_g), np.asarray(split_groups[0][1]),
+                    n_splits_override=int(TW_g.shape[0]),
+                )
+            else:
+                fn, fresh_compile = _get_compiled(
+                    kernel, static_key, static, mesh, trial_axis, data, plan,
+                    chunk, hyper_names, X, y_np, plan.train_w, plan.eval_w,
+                )
 
         for start in range(0, len(idxs), chunk):
             batch_idx = idxs[start : start + chunk]
@@ -304,6 +354,19 @@ def run_trials(
             t0 = time.perf_counter()
             if t_first_dispatch is None:
                 t_first_dispatch = t0
+            if split_groups is not None:
+                group_outs = []
+                for twg, ewg, size in split_groups:
+                    group_outs.append((fn(X_d, y_d, twg, ewg, hyper_arg), size))
+                    dispatches += 1
+                if fresh_compile and start == 0:
+                    group_outs = [
+                        (jax.block_until_ready(og), size)
+                        for og, size in group_outs
+                    ]
+                    compile_time += time.perf_counter() - t0
+                pending.append((group_outs, batch_idx))
+                continue
             out = fn(X_d, y_d, TW_d, EW_d, hyper_arg)
             if fresh_compile and start == 0:
                 # block only on a fresh executable's first dispatch so its
@@ -428,8 +491,10 @@ def _mesh_signature(mesh):
 
 
 def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
-                  hyper_names, X_proto=None, y=None, TW=None, EW=None):
+                  hyper_names, X_proto=None, y=None, TW=None, EW=None,
+                  n_splits_override=None):
     has_hyper = bool(hyper_names)
+    n_splits_key = n_splits_override or plan.n_splits
     # a 1-device mesh is compilation-equivalent to no mesh: drop the
     # NamedShardings so the executable is AOT-exportable and its disk key is
     # mesh-independent (single chip is the bench/measure environment)
@@ -441,7 +506,7 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
         tuple(sorted((k, str(v)) for k, v in static.items())),
         data.X.shape,
         data.n_classes,
-        plan.n_splits,
+        n_splits_key,
         chunk,
         _mesh_signature(mesh),
     )
@@ -492,7 +557,7 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
         )
         example = _example_args(X_ex, y, TW, EW, hyper_names, chunk)
         disk_key = ("generic",) + _aot_key(
-            kernel, static, X_ex, data.n_classes, plan.n_splits, chunk, hyper_names
+            kernel, static, X_ex, data.n_classes, n_splits_key, chunk, hyper_names
         )
         fn, _ = aot_jit(batched, disk_key, example)
     _compiled_cache[cache_key] = fn

@@ -153,3 +153,17 @@ def test_svc_full_covertype_completes():
     idx = rng.permutation(len(X))[:30_000]
     sk = cross_val_score(SVC(C=1.0), X[idx], y[idx], cv=3).mean()
     assert ours > sk - 0.08, (ours, sk)
+
+
+def test_nystrom_landmarks_scale_with_n(monkeypatch):
+    """m grows with n up to the 4096 cap (VERDICT r3: flat m=2048 left a
+    -0.045 CV gap at full Covertype; rank must track the data)."""
+    from cs230_distributed_machine_learning_tpu.models import svm as svm_mod
+
+    monkeypatch.delenv("CS230_SVM_NYSTROM_M", raising=False)
+    assert svm_mod._nystrom_m(31_000) == 2048
+    assert svm_mod._nystrom_m(58_000) == 3625
+    assert svm_mod._nystrom_m(116_000) == 4096
+    assert svm_mod._nystrom_m(10**7) == 4096
+    monkeypatch.setenv("CS230_SVM_NYSTROM_M", "512")
+    assert svm_mod._nystrom_m(116_000) == 512

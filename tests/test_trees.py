@@ -276,3 +276,36 @@ def test_gather_free_ops_match_reference_forms():
     np.testing.assert_allclose(
         np.asarray(gain)[fin], np.asarray(ref)[fin], rtol=1e-4, atol=1e-3
     )
+
+
+def test_compact_histogram_matches_dense(monkeypatch):
+    """The sparsity-exploiting (sorted/supergroup-padded) level histogram
+    must reproduce the dense one-hot form bit-exactly for integer stats —
+    including skewed node populations, mostly-dead rows, and node counts
+    that straddle supergroup boundaries. (Kept off by default: the r3 A/B
+    measured dense FASTER on v5e — see _COMPACT_R note in ops/trees.py.)"""
+    import jax.numpy as jnp
+
+    import cs230_distributed_machine_learning_tpu.ops.trees as ot
+
+    monkeypatch.setattr(ot, "_COMPACT_R", 256)
+    monkeypatch.setattr(ot, "_COMPACT_M", 16)
+    rng = np.random.RandomState(7)
+    for mode in range(4):
+        n, d, nb, W, kk = 4097, 6, 32, 70, 3
+        if mode == 0:
+            slot = rng.randint(0, W + 1, n)
+        elif mode == 1:  # few huge nodes + sparse tail
+            slot = np.where(rng.rand(n) < 0.7, rng.randint(0, 2, n),
+                            rng.randint(0, W + 1, n))
+        elif mode == 2:  # mostly dead rows
+            slot = np.where(rng.rand(n) < 0.85, W, rng.randint(0, W, n))
+        else:  # every node singleton-ish
+            slot = np.arange(n) % (W + 1)
+        xb = jnp.asarray(rng.randint(0, nb, (n, d)), jnp.int32)
+        SC = jnp.asarray(rng.randint(0, 5, (n, kk)), jnp.float32)
+        dense = np.asarray(ot._level_histogram(
+            jnp.asarray(slot), xb, SC, W, nb, None))
+        compact = np.asarray(ot._level_histogram_compact(
+            jnp.asarray(slot), xb, SC, W, nb, None))
+        np.testing.assert_array_equal(dense, compact, err_msg=f"mode {mode}")

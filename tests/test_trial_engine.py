@@ -86,3 +86,28 @@ def test_host_fast_path_used_for_tiny_buckets(monkeypatch):
     assert len(out.trial_metrics) == 3
     for m in out.trial_metrics:
         assert 0.5 <= m["mean_cv_score"] <= 1.0
+
+
+def test_generic_split_group_chunking_matches_monolithic(monkeypatch):
+    """When one trial x all folds exceeds the memory budget, the generic
+    (non-chunked-protocol) path must run fold groups across dispatches and
+    still produce identical metrics — Nyström SVC's [n, m]-per-lane OOM at
+    full Covertype is the motivating case (r3)."""
+    data = _iris_data()
+    plan = build_split_plan(np.asarray(data.y), task="classification", n_folds=5)
+    kernel = get_kernel("LogisticRegression")
+    params = [{"C": 1.0}]
+
+    base = run_trials(kernel, data, plan, params)
+
+    # tiny budget: per-split estimate x 6 splits >> budget -> fold groups
+    monkeypatch.setattr(trial_map, "_device_memory_mb", lambda: 4.0 * max(
+        kernel.memory_estimate_mb(len(data.X), data.X.shape[1], {"_n_classes": 3}),
+        0.5))
+    trial_map._compiled_cache.clear()
+    grouped = run_trials(kernel, data, plan, params)
+
+    assert grouped.n_dispatches > base.n_dispatches
+    a, b = base.trial_metrics[0], grouped.trial_metrics[0]
+    assert a["accuracy"] == b["accuracy"]
+    np.testing.assert_allclose(a["cv_scores"], b["cv_scores"], atol=1e-6)
