@@ -207,6 +207,24 @@ def main() -> None:
         rows.append(row)
         print(json.dumps(row))
 
+    # merge by model into any existing matrix, so a partial --families run
+    # refreshes its rows without dropping the rest (same contract as the
+    # scaling curve's merge-by-fraction)
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                old = json.load(f)
+            fresh = {r["model"] for r in rows}
+            # only rows measured at the SAME n_rows merge — a different
+            # --frac must not mix incomparable rows into one table
+            rows = [
+                r for r in old
+                if r.get("model") not in fresh and r.get("n_rows") == n
+            ] + rows
+            order = {m: i for i, m in enumerate(FAMILIES)}
+            rows.sort(key=lambda r: order.get(r.get("model"), 99))
+        except (OSError, ValueError):
+            pass
     tmp = f"{args.out}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(rows, f, indent=1)
