@@ -114,25 +114,10 @@ def main() -> None:
     idx = rng.permutation(len(X_full))[:n]
     Xf, yf = X_full[idx], y_full[idx]
 
-    from cs230_distributed_machine_learning_tpu.data.datasets import dataset_dir
+    from cs230_distributed_machine_learning_tpu.data.datasets import stage_arrays
 
     did = f"covertype_matrix_{n}"  # keyed by row count: no fraction collisions
-    ddir = os.path.join(dataset_dir(did), "preprocessed")
-    os.makedirs(ddir, exist_ok=True)
-    csv = os.path.join(ddir, f"{did}_preprocessed.csv")
-
-    def _row_count(path):
-        with open(path) as f:
-            return sum(1 for _ in f) - 1
-
-    if not os.path.exists(csv) or _row_count(csv) != n:
-        import pandas as pd
-
-        df = pd.DataFrame(Xf)
-        df["target"] = yf
-        tmp = csv + f".tmp.{os.getpid()}"
-        df.to_csv(tmp, index=False)
-        os.replace(tmp, csv)  # atomic: a torn write can't pass the row check
+    stage_arrays(did, Xf, yf)
 
     rows = []
     for name in args.families:
@@ -214,9 +199,19 @@ def main() -> None:
         try:
             with open(args.out) as f:
                 old = json.load(f)
+            if not (isinstance(old, list)
+                    and all(isinstance(r, dict) for r in old)):
+                raise ValueError(f"unexpected shape in {args.out}")
             fresh = {r["model"] for r in rows}
             # only rows measured at the SAME n_rows merge — a different
             # --frac must not mix incomparable rows into one table
+            dropped = [r["model"] for r in old
+                       if r.get("model") not in fresh and r.get("n_rows") != n]
+            if dropped:
+                print(f"NOTE: dropping {len(dropped)} row(s) measured at a "
+                      f"different n_rows ({', '.join(map(str, dropped))}) — "
+                      "re-run those families at this --frac to restore them",
+                      file=sys.stderr)
             rows = [
                 r for r in old
                 if r.get("model") not in fresh and r.get("n_rows") == n

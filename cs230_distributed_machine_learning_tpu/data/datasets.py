@@ -46,6 +46,32 @@ def find_csv(dataset_id: str, *, preprocessed: bool = False, root: Optional[str]
     return hits[0] if hits else None
 
 
+def stage_arrays(dataset_id: str, X, y, *, root: Optional[str] = None) -> str:
+    """Stage (X, y) as a preprocessed CSV dataset (target last column),
+    atomically, skipping when already staged with the same row count —
+    the shared staging block the benchmark harnesses and slow-parity
+    tests previously each re-implemented. Returns the CSV path."""
+    import numpy as np
+    import pandas as pd
+
+    n = len(X)
+    ddir = os.path.join(dataset_dir(dataset_id, root), "preprocessed")
+    os.makedirs(ddir, exist_ok=True)
+    csv = os.path.join(ddir, f"{dataset_id}_preprocessed.csv")
+
+    def _rows(path):
+        with open(path) as f:
+            return sum(1 for _ in f) - 1
+
+    if not os.path.exists(csv) or _rows(csv) != n:
+        df = pd.DataFrame(np.asarray(X))
+        df["target"] = np.asarray(y)
+        tmp = csv + f".tmp.{os.getpid()}"
+        df.to_csv(tmp, index=False)
+        os.replace(tmp, csv)  # atomic: a torn write can't pass the row check
+    return csv
+
+
 def collect_csv_metadata(path: str) -> Dict[str, Any]:
     """n_rows / n_cols / size_mb, the features the runtime predictor learns
     from (reference ``dataset_util.py:119-136``)."""

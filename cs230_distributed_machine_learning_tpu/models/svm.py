@@ -111,8 +111,17 @@ def _nesterov_primal(Z, grad_fn, L_est, steps):
 
 
 def _lipschitz_eta(Q):
-    """1/lambda_max(Q) step size by 25-iteration power method."""
-    v = jnp.ones((Q.shape[0],), jnp.float32)
+    """1/lambda_max(Q) step size by 25-iteration power method.
+
+    The start vector is a fixed pseudo-random waveform: an all-ones start
+    sits EXACTLY in the null space of the SVR block matrix [[K,-K],[-K,K]]
+    (Q @ [u;u] = 0 by construction) and would leave the estimate riding on
+    float rounding noise; any structured pattern risks a similar
+    orthogonality accident (alternating signs re-enter that null space at
+    even n). cos(1.7*i + 0.3) has non-negligible overlap with every
+    eigenspace of interest and is deterministic across runs."""
+    n = Q.shape[0]
+    v = jnp.cos(1.7 * jnp.arange(n, dtype=jnp.float32) + 0.3)
 
     def power(v, _):
         u = Q @ v

@@ -8,9 +8,13 @@ Must run before jax initializes a backend, hence the env mutation at import.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# TPUML_TEST_PLATFORM=tpu lets the gated slow-parity tests (deep-arena
+# Covertype fits) run on the real chip — they are compute-infeasible on
+# the CPU backend. Everything else stays pinned to the virtual CPU mesh.
+_plat = os.environ.get("TPUML_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _plat
 _flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
+if _plat == "cpu" and "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 # The axon TPU plugin (when present) force-registers itself regardless of
@@ -18,7 +22,8 @@ if "xla_force_host_platform_device_count" not in _flags:
 # backend initialization.
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if _plat == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

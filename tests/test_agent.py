@@ -70,3 +70,37 @@ def test_agent_heartbeats_keep_it_alive(http_coordinator):
         assert agent.worker_id in coord.cluster.engine.worker_snapshot()
     finally:
         agent.stop()
+
+
+def test_prefetch_agree_flags_unfetchable_and_mismatched_datasets():
+    """SPMD lockstep guard (runtime/agent._prefetch_agree): datasets that
+    fail to stage — or stage with different shapes than another rank —
+    must be agreed bad BEFORE any collective, so the batch skips them on
+    every rank identically. Single-process form: allgather degenerates to
+    the local signature."""
+    from cs230_distributed_machine_learning_tpu.runtime.agent import (
+        _prefetch_agree,
+    )
+
+    class _Data:
+        def __init__(self, n, d):
+            import numpy as np
+
+            self.X = np.zeros((n, d), np.float32)
+
+    class _Cache:
+        def get(self, did, task):
+            if did == "missing":
+                raise FileNotFoundError(did)
+            return _Data(100, 4)
+
+    class _Exec:
+        cache = _Cache()
+
+    tasks = [
+        {"dataset_id": "iris", "model_type": "LogisticRegression"},
+        {"dataset_id": "missing", "model_type": "LogisticRegression"},
+        {"dataset_id": "iris", "model_type": "LogisticRegression"},
+    ]
+    bad = _prefetch_agree(_Exec(), tasks)
+    assert bad == ["missing"]
