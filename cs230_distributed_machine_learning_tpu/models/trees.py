@@ -154,7 +154,25 @@ class _TreeBase(ModelKernel):
                 )
             else:
                 levels = min(int(depth), _DEEP_LEVELS_EXPLICIT)
-            width = min(_DEEP_W, max(64, 1 << int(np.ceil(np.log2(max(n // 64, 64))))))
+            # leaf-density rule above 10k rows: the arena's leaf budget
+            # (~width x levels) tracks n at ~1 leaf per 5-6 rows — n/128
+            # with a 256 floor hits exactly the measured parity points
+            # (11.6k->256 cv ±0.000, 29k->256 cv -0.007, 58k->512 cv
+            # -0.007, 116k->512-capped cv -0.018) without paying W=512
+            # where 256 already sits inside the 0.01 band. Below 10k the
+            # r2 n/64 rule stays: its widths are the measured ones there
+            # (5.8k->128 matches the committed 5% row; test-scale deep
+            # fits keep their 64-wide arenas instead of paying 4x).
+            if n >= 10_000:
+                width = min(
+                    _DEEP_W,
+                    max(256, 1 << int(np.ceil(np.log2(max(n // 128, 64))))),
+                )
+            else:
+                width = min(
+                    _DEEP_W,
+                    max(64, 1 << int(np.ceil(np.log2(max(n // 64, 64))))),
+                )
             depth = levels
             # coarser quantile bins in the deep arena (see sweep table at
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
