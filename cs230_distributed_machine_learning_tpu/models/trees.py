@@ -154,25 +154,23 @@ class _TreeBase(ModelKernel):
                 )
             else:
                 levels = min(int(depth), _DEEP_LEVELS_EXPLICIT)
-            # leaf-density rule above 10k rows: the arena's leaf budget
-            # (~width x levels) tracks n at ~1 leaf per 5-6 rows — n/128
-            # with a 256 floor hits exactly the measured parity points
-            # (11.6k->256 cv ±0.000, 29k->256 cv -0.007, 58k->512 cv
-            # -0.007, 116k->512-capped cv -0.018) without paying W=512
-            # where 256 already sits inside the 0.01 band. Below 10k the
-            # r2 n/64 rule stays: its widths are the measured ones there
-            # (5.8k->128 matches the committed 5% row; test-scale deep
-            # fits keep their 64-wide arenas instead of paying 4x).
-            if n >= 10_000:
-                width = min(
-                    _DEEP_W,
-                    max(256, 1 << int(np.ceil(np.log2(max(n // 128, 64))))),
-                )
+            # Width by explicit monotone bands anchored at on-device
+            # parity measurements (Covertype RF-100, CV delta vs sklearn
+            # in parens): 5.8k->128 (+0.003), 11.6k->128 (-0.006, 10.6 s
+            # = 3.0x sklearn), 29k->256 (-0.007), 58k->512 (-0.007),
+            # 116k->512-capped (-0.018). Band edges sit between measured
+            # points, so every n gets the narrowest width whose band
+            # endpoints sat inside the 0.01 parity band; test-scale deep
+            # fits (n just over the 4096 threshold) keep 64-wide arenas.
+            if n <= 5000:
+                width = 64
+            elif n <= 24576:
+                width = 128
+            elif n <= 49152:
+                width = 256
             else:
-                width = min(
-                    _DEEP_W,
-                    max(64, 1 << int(np.ceil(np.log2(max(n // 64, 64))))),
-                )
+                width = 512
+            width = min(_DEEP_W, width)
             depth = levels
             # coarser quantile bins in the deep arena (see sweep table at
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
