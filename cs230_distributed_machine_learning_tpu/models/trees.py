@@ -78,6 +78,21 @@ _DEEP_W = int(os.environ.get("CS230_DEEP_W", "512"))
 _DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "48"))
 
 
+_deep_w_force_warned: set = set()
+
+
+def _warn_deep_w_force(width: int) -> None:
+    if width in _deep_w_force_warned:
+        return
+    _deep_w_force_warned.add(width)
+    from ..utils import get_logger
+
+    get_logger().warning(
+        "CS230_DEEP_W_FORCE=%d overrides the deep-arena width bands for "
+        "EVERY grow-to-purity fit in this process", width,
+    )
+
+
 _deep_bins_warned: set = set()
 
 
@@ -162,15 +177,32 @@ class _TreeBase(ModelKernel):
             # points, so every n gets the narrowest width whose band
             # endpoints sat inside the 0.01 parity band; test-scale deep
             # fits (n just over the 4096 threshold) keep 64-wide arenas.
-            if n <= 5000:
-                width = 64
-            elif n <= 24576:
-                width = 128
-            elif n <= 49152:
-                width = 256
+            force_w = os.environ.get("CS230_DEEP_W_FORCE")
+            if force_w:
+                # sweep/parity hook: bypass the width bands entirely (the
+                # BASELINE.md full-scale Pareto knob). Applies to EVERY
+                # deep fit while set — warn once so a forgotten export
+                # doesn't silently inflate small fits 12x.
+                try:
+                    width = int(force_w)
+                    if width < 64:
+                        raise ValueError(force_w)
+                except ValueError:
+                    raise ValueError(
+                        f"CS230_DEEP_W_FORCE={force_w!r}: expected an "
+                        "integer arena width >= 64"
+                    ) from None
+                _warn_deep_w_force(width)
             else:
-                width = 512
-            width = min(_DEEP_W, width)
+                if n <= 5000:
+                    width = 64
+                elif n <= 24576:
+                    width = 128
+                elif n <= 49152:
+                    width = 256
+                else:
+                    width = 512
+                width = min(_DEEP_W, width)
             depth = levels
             # coarser quantile bins in the deep arena (see sweep table at
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
