@@ -29,7 +29,13 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
-from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+from ..ops.metrics import (
+    classification_score,
+    margin_score,
+    regression_score,
+    scoring_needs_margin,
+    weighted_mse,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,15 +134,38 @@ class ModelKernel(abc.ABC):
 
     def evaluate(self, params, X, y, w, static: Dict[str, Any]) -> Dict[str, Any]:
         """Score on rows selected by ``w``. Returns {"score": ...} plus
-        task-specific extras (reference scoring: accuracy for classifiers,
-        r2 + MSE for regressors, worker.py:320-349)."""
-        y_pred = self.predict(params, X, static)
+        task-specific extras. Default scoring matches the reference worker
+        (accuracy for classifiers, r2 + MSE for regressors,
+        worker.py:320-349); a job-level ``scoring`` (static ``_scoring``,
+        from the search wrapper's cv_params) swaps in the matching jittable
+        scorer from ops/metrics.py — honoring what the reference client
+        captured but its worker dropped (core.py:135-138)."""
+        scoring = static.get("_scoring")
         if self.task == "classification":
-            return {"score": weighted_accuracy(y, y_pred, w)}
+            if scoring_needs_margin(scoring):
+                margin = self.predict_margin(params, X, static)
+                return {"score": margin_score(scoring, y, margin, w)}
+            y_pred = self.predict(params, X, static)
+            return {
+                "score": classification_score(
+                    scoring, y, y_pred, w, static.get("_n_classes", 2)
+                )
+            }
+        y_pred = self.predict(params, X, static)
         return {
-            "score": weighted_r2(y, y_pred, w),
+            "score": regression_score(scoring, y, y_pred, w),
             "mse": weighted_mse(y, y_pred, w),
         }
+
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        """Continuous decision score for the positive class (binary) —
+        required by margin-based scorers (roc_auc). Kernels with a natural
+        margin (logit difference, decision function) override this."""
+        raise NotImplementedError(
+            f"scoring requires a decision margin, which the {self.name} "
+            "kernel does not expose (supported: kernels overriding "
+            "predict_margin)"
+        )
 
     # Rough per-trial working-set estimate in MB, used by the placement
     # engine's memory-aware scoring (parity with WorkerState.mem_load_mb,

@@ -191,12 +191,18 @@ class _KNNBase(ModelKernel):
         return jax.lax.dynamic_update_slice(state, preds, (start,))
 
     def chunk_eval(self, X, y, w_eval, hyper, static, state):
-        from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+        from ..ops.metrics import (
+            classification_score,
+            regression_score,
+            weighted_mse,
+        )
 
+        scoring = static.get("_scoring")
         if self.task == "classification":
-            return {"score": weighted_accuracy(y, state, w_eval)}
+            return {"score": classification_score(
+                scoring, y, state, w_eval, static.get("_n_classes", 2))}
         return {
-            "score": weighted_r2(y, state, w_eval),
+            "score": regression_score(scoring, y, state, w_eval),
             "mse": weighted_mse(y, state, w_eval),
         }
 

@@ -133,6 +133,15 @@ class LogisticRegressionKernel(ModelKernel):
         A = add_intercept(X, fit_intercept)
         return jnp.argmax(A @ params, axis=-1).astype(jnp.int32)
 
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        """Binary decision margin = logit(class 1) - logit(class 0) (the
+        2-column softmax's logit difference equals sklearn's single-logit
+        decision_function up to solver tolerance)."""
+        fit_intercept = bool(static.get("fit_intercept", True))
+        A = add_intercept(X, fit_intercept)
+        Z = A @ params
+        return Z[:, 1] - Z[:, 0]
+
     def memory_estimate_mb(self, n, d, static):
         # marginal per-(trial,split) working set: a few [n, c] activation/
         # gradient buffers (the [n, d] design matrix is shared, not vmapped)

@@ -192,6 +192,10 @@ class MLPClassifierKernel(_MLPBase):
         logits = self._forward(params, X.astype(jnp.float32), static)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        logits = self._forward(params, X.astype(jnp.float32), static)
+        return logits[:, 1] - logits[:, 0]
+
 
 class MLPRegressorKernel(_MLPBase):
     name = "MLPRegressor"

@@ -42,7 +42,7 @@ class GaussianNBKernel(ModelKernel):
         prior = counts / jnp.sum(counts)
         return {"mean": mean, "var": var, "log_prior": jnp.log(prior)}
 
-    def predict(self, params, X, static: Dict[str, Any]):
+    def _log_joint(self, params, X):
         X = X.astype(jnp.float32)
         mean, var = params["mean"], params["var"]  # [c, d]
         ll = -0.5 * jnp.sum(
@@ -50,7 +50,14 @@ class GaussianNBKernel(ModelKernel):
             + (X[:, None, :] - mean[None, :, :]) ** 2 / var[None, :, :],
             axis=-1,
         )
-        return jnp.argmax(ll + params["log_prior"][None, :], axis=-1).astype(jnp.int32)
+        return ll + params["log_prior"][None, :]
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        return jnp.argmax(self._log_joint(params, X), axis=-1).astype(jnp.int32)
+
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        lj = self._log_joint(params, X)
+        return lj[:, 1] - lj[:, 0]
 
 
 class _DecisionTreeBase(_TreeBase):
@@ -98,6 +105,11 @@ class DecisionTreeClassifierKernel(_DecisionTreeBase):
         xq = self._query_bins(params, X, static)
         proba = self._tree_predict(xq, params["tree"], static)
         return jnp.argmax(proba, axis=-1).astype(jnp.int32)
+
+    def predict_margin(self, params, X, static):
+        xq = self._query_bins(params, X, static)
+        proba = self._tree_predict(xq, params["tree"], static)
+        return proba[:, 1] - proba[:, 0]
 
 
 class DecisionTreeRegressorKernel(_DecisionTreeBase):

@@ -282,34 +282,43 @@ class SVCKernel(ModelKernel):
             "pairs_b": pb,
         }
 
-    def predict(self, params, X, static: Dict[str, Any]):
-        c = max(int(static["_n_classes"]), 2)
+    def _pair_decisions(self, params, X, static: Dict[str, Any]):
+        """[nq, n_pairs] OvO decision values; >0 votes pairs_a."""
         if "W" in params:
             Zq = _gram(
                 X.astype(jnp.float32), params["landmarks"], static["kernel"],
                 params["gamma"], static.get("degree", 3), static.get("coef0", 0.0),
             ) @ params["inv_sqrt"]
             Zq = jnp.concatenate([Zq, jnp.ones((X.shape[0], 1), jnp.float32)], 1)
-            dec = Zq @ params["W"].T  # [nq, n_pairs]
-        else:
-            Kq = _gram(
-                X.astype(jnp.float32),
-                params["X"],
-                static["kernel"],
-                params["gamma"],
-                static.get("degree", 3),
-                static.get("coef0", 0.0),
-            )
-            dec = Kq @ params["dual"].T  # [nq, n_pairs], >0 votes class pairs_a
-            if "intercept" in params:
-                dec = dec + params["intercept"][None, :]
-            else:  # artifacts fitted before the KKT-intercept form: K+1 bias
-                dec = dec + jnp.sum(params["dual"], axis=1)[None, :]
+            return Zq @ params["W"].T
+        Kq = _gram(
+            X.astype(jnp.float32),
+            params["X"],
+            static["kernel"],
+            params["gamma"],
+            static.get("degree", 3),
+            static.get("coef0", 0.0),
+        )
+        dec = Kq @ params["dual"].T  # [nq, n_pairs], >0 votes class pairs_a
+        if "intercept" in params:
+            return dec + params["intercept"][None, :]
+        # artifacts fitted before the KKT-intercept form: K+1 bias
+        return dec + jnp.sum(params["dual"], axis=1)[None, :]
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        c = max(int(static["_n_classes"]), 2)
+        dec = self._pair_decisions(params, X, static)
         vote_a = (dec > 0).astype(jnp.float32)
         votes = jnp.zeros((X.shape[0], c), jnp.float32)
         votes = votes.at[:, params["pairs_a"]].add(vote_a)
         votes = votes.at[:, params["pairs_b"]].add(1.0 - vote_a)
         return jnp.argmax(votes, axis=-1).astype(jnp.int32)
+
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        """Binary decision function, positive for class 1 (the single OvO
+        pair's value is positive for pairs_a == class 0, hence the sign
+        flip — matches sklearn's binary decision_function orientation)."""
+        return -self._pair_decisions(params, X, static)[:, 0]
 
     def _gamma(self, X, w, static):
         if static.get("_gamma_mode") == "numeric":

@@ -467,16 +467,26 @@ class _RandomForestBase(_TreeBase):
         return state
 
     def chunk_eval(self, X, y, w_eval, hyper, static, state):
-        from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+        from ..ops.metrics import (
+            classification_score,
+            margin_score,
+            regression_score,
+            scoring_needs_margin,
+            weighted_mse,
+        )
 
+        scoring = static.get("_scoring")
         n_trees = int(static.get("n_estimators", 100))
         mean = state / float(n_trees)
         if self.task == "classification":
+            if scoring_needs_margin(scoring):
+                return {"score": margin_score(scoring, y, mean[:, 1] - mean[:, 0], w_eval)}
             pred = jnp.argmax(mean, axis=-1).astype(jnp.int32)
-            return {"score": weighted_accuracy(y, pred, w_eval)}
+            return {"score": classification_score(
+                scoring, y, pred, w_eval, static.get("_n_classes", 2))}
         pred = mean[:, 0]
         return {
-            "score": weighted_r2(y, pred, w_eval),
+            "score": regression_score(scoring, y, pred, w_eval),
             "mse": weighted_mse(y, pred, w_eval),
         }
 
@@ -525,6 +535,14 @@ class RandomForestClassifierKernel(_RandomForestBase):
         xq = self._query_bins(params, X, static)
         proba = self._forest_leaf_mean(params, xq, static)
         return jnp.argmax(proba, axis=-1).astype(jnp.int32)
+
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        """Binary margin = p(class 1) - p(class 0): monotone in the positive
+        class probability, so rank metrics (roc_auc) match sklearn's
+        predict_proba[:, 1] ranking."""
+        xq = self._query_bins(params, X, static)
+        proba = self._forest_leaf_mean(params, xq, static)
+        return proba[:, 1] - proba[:, 0]
 
 
 class RandomForestRegressorKernel(_RandomForestBase):
@@ -589,13 +607,26 @@ class _GradientBoostingBase(_TreeBase):
         return state
 
     def chunk_eval(self, X, y, w_eval, hyper, static, state):
-        from ..ops.metrics import weighted_accuracy, weighted_mse, weighted_r2
+        from ..ops.metrics import (
+            classification_score,
+            margin_score,
+            regression_score,
+            scoring_needs_margin,
+            weighted_mse,
+        )
 
+        scoring = static.get("_scoring")
         if self.task == "classification":
+            if scoring_needs_margin(scoring):
+                # binary F keeps column 0 at zero, so the logit difference
+                # is just F[:, 1] - F[:, 0]
+                return {"score": margin_score(
+                    scoring, y, state[:, 1] - state[:, 0], w_eval)}
             pred = jnp.argmax(state, axis=-1).astype(jnp.int32)
-            return {"score": weighted_accuracy(y, pred, w_eval)}
+            return {"score": classification_score(
+                scoring, y, pred, w_eval, static.get("_n_classes", 2))}
         return {
-            "score": weighted_r2(y, state, w_eval),
+            "score": regression_score(scoring, y, state, w_eval),
             "mse": weighted_mse(y, state, w_eval),
         }
 
@@ -742,7 +773,7 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
         )
         return self.assemble_artifact(trees, X, hyper, static, y, w)
 
-    def predict(self, params, X, static: Dict[str, Any]):
+    def _raw_scores(self, params, X, static: Dict[str, Any]):
         c = max(int(static["_n_classes"]), 2)
         depth, nbq = static["_depth"], static["_n_bins"]
         xq = self._query_bins(params, X, static)
@@ -768,7 +799,14 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
             )
         )
         F, _ = jax.lax.scan(per_stage, F0, params["trees"])
-        return jnp.argmax(F, axis=-1).astype(jnp.int32)
+        return F
+
+    def predict(self, params, X, static: Dict[str, Any]):
+        return jnp.argmax(self._raw_scores(params, X, static), axis=-1).astype(jnp.int32)
+
+    def predict_margin(self, params, X, static: Dict[str, Any]):
+        F = self._raw_scores(params, X, static)
+        return F[:, 1] - F[:, 0]
 
 
 class GradientBoostingRegressorKernel(_GradientBoostingBase):

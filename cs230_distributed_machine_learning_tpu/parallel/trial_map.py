@@ -110,8 +110,22 @@ def run_trials(
     mesh: Optional[Mesh] = None,
     trial_axis: str = "trials",
     max_trials_per_batch: int = 256,
+    scoring: Optional[str] = None,
 ) -> TrialRunResult:
-    """Run all trials (one per param dict), bucketing by static config."""
+    """Run all trials (one per param dict), bucketing by static config.
+
+    ``scoring`` is a sklearn scorer name honored by every kernel's evaluate
+    (ops/metrics.py registry); None keeps the reference worker's defaults
+    (accuracy / r2). It joins the static dict, so it is part of every
+    executable cache key.
+    """
+    if scoring is not None:
+        # fail loudly at the engine boundary, not inside a trace: every
+        # entry point (executor, benchmarks, direct callers) inherits the
+        # unknown-name / multiclass-binary / margin-capability checks
+        from ..ops.metrics import validate_scoring
+
+        validate_scoring(scoring, kernel.task, data.n_classes, kernel)
     n, d = data.X.shape
     results: List[Optional[Dict[str, Any]]] = [None] * len(param_dicts)
     compile_time = 0.0
@@ -164,7 +178,7 @@ def run_trials(
             else:
                 out = _fetch(jax.block_until_ready(out))
             for j, gi in enumerate(batch_idx):
-                results[gi] = _postprocess(out, j, plan, kernel.task)
+                results[gi] = _postprocess(out, j, plan, kernel.task, scoring)
         pending.clear()
         if t_first_dispatch is not None:
             run_time += time.perf_counter() - t_first_dispatch
@@ -176,6 +190,10 @@ def run_trials(
         if hasattr(kernel, "resolve_static"):
             static = kernel.resolve_static(static, n, d, data.n_classes)
         static["_n_classes"] = data.n_classes
+        if scoring is not None:
+            # only non-default scorers join the key: default jobs keep their
+            # (already disk-cached) executables byte-identical
+            static["_scoring"] = scoring
 
         # bucket-level data prep (e.g. feature binning for trees): computed
         # once, shared by every trial and split in the bucket
@@ -256,7 +274,8 @@ def run_trials(
         # chunk geometry. Single-device only — the trial mesh axis is
         # handled by the generic sharded path.
         batched_fn = None
-        if hasattr(kernel, "build_batched_fn") and single_device and not host_exec:
+        if (hasattr(kernel, "build_batched_fn") and single_device and not host_exec
+                and scoring is None):  # fused paths score by the default metric
             Tw = getattr(kernel, "batched_trial_multiple", 128)
             cap = getattr(kernel, "batched_chunk_cap", 1024)
             bchunk = max(Tw, min(cap, pad_to_multiple(len(idxs), Tw)))
@@ -742,24 +761,32 @@ def _run_chunked(
         dispatches += (2 + n_chunks) * len(split_groups)
 
         for j, gi in enumerate(batch_idx):
-            results[gi] = _postprocess(out, j, plan, kernel.task)
+            results[gi] = _postprocess(
+                out, j, plan, kernel.task, static.get("_scoring")
+            )
 
     return compile_time, run_time, dispatches
 
 
-def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str) -> Dict[str, Any]:
+def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str,
+                 scoring: Optional[str] = None) -> Dict[str, Any]:
     """Split 0 = holdout test metrics; splits 1..K = CV fold scores.
-    mean_cv_score is the trial-ranking key (reference task_handler.py:254-263)."""
+    mean_cv_score is the trial-ranking key (reference task_handler.py:254-263).
+    With a custom ``scoring``, the holdout score is reported under the scorer
+    name instead of the default accuracy/r2_score keys."""
     metrics: Dict[str, Any] = {}
     score = float(out["score"][j, 0])
-    if task == "classification":
+    if scoring is not None:
+        metrics[scoring] = score
+        metrics["scoring"] = scoring
+    elif task == "classification":
         metrics["accuracy"] = score
     elif task == "transform":
         metrics["score"] = score
     else:
         metrics["r2_score"] = score
-        if "mse" in out:
-            metrics["mse"] = float(out["mse"][j, 0])
+    if task == "regression" and "mse" in out:
+        metrics["mse"] = float(out["mse"][j, 0])
     if plan.n_folds >= 2:
         cv = out["score"][j, 1:]
         metrics["cv_scores"] = [float(v) for v in cv]

@@ -158,6 +158,9 @@ class LocalExecutor:
                 kernel = get_kernel(model_type)
                 data = self.cache.get(dataset_id, kernel.task)
                 tp = subtasks[idxs[0]].get("train_params", {}) or {}
+                scoring = _normalize_scoring(
+                    tp.get("scoring"), kernel.task, data.n_classes, kernel
+                )
                 plan = build_split_plan(
                     data.y if kernel.task == "regression" else _np(data.y),
                     task=kernel.task,
@@ -176,6 +179,7 @@ class LocalExecutor:
                         mesh=self.mesh,
                         trial_axis=self.trial_axis,
                         max_trials_per_batch=self.max_trials_per_batch,
+                        scoring=scoring,
                     )
                 finished_at = time.time()
                 resources = sampler.averages()
@@ -349,6 +353,26 @@ def _np(y):
     import numpy as np
 
     return np.asarray(y)
+
+
+def _normalize_scoring(scoring, task: str, n_classes: int = 0, kernel=None):
+    """Validate a job's ``scoring`` and collapse the task defaults to None
+    (so default jobs keep their cached executables). The reference worker
+    silently dropped custom scoring (worker.py:320-349); here an unsupported
+    scorer fails the batch with a clear error instead — including the cases
+    sklearn itself rejects (binary-average scorers on multiclass targets)
+    and the one it can't know about (margin scorers on kernels with no
+    decision margin)."""
+    from ..ops.metrics import validate_scoring
+
+    if scoring is None:
+        return None
+    if task != "transform" and scoring == (
+        "accuracy" if task == "classification" else "r2"
+    ):
+        return None
+    validate_scoring(scoring, task, n_classes, kernel)
+    return scoring
 
 
 def _coerce_cv(cv) -> int:

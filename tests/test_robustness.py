@@ -108,8 +108,8 @@ def test_diverged_trial_ranks_last(monkeypatch):
 
     real_post = trial_map._postprocess
 
-    def poisoned(out, j, plan, task):
-        metrics = real_post(out, j, plan, task)
+    def poisoned(out, j, plan, task, scoring=None):
+        metrics = real_post(out, j, plan, task, scoring)
         if j == 0:  # simulate a diverged fit the way the sanitizer tags it
             metrics["mean_cv_score"] = float("-inf")
             metrics["diverged"] = True

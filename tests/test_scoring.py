@@ -1,0 +1,339 @@
+"""Custom ``scoring`` honored end-to-end (VERDICT r3 item 3).
+
+The reference client captures ``scoring`` from search wrappers
+(``DistributedLibrary/src/distributed_ml/core.py:135-138``) but its worker
+always scores accuracy/r2 (``aws-prod/worker/worker.py:320-349``) — so a
+user passing ``GridSearchCV(..., scoring="f1_macro")`` silently got
+accuracy-ranked results. Here the jittable scorer registry (ops/metrics.py)
+ranks trials by the requested scorer, and ``best_params_`` matches sklearn.
+"""
+
+import os
+
+import numpy as np
+import pytest
+from sklearn.datasets import make_classification, make_regression
+from sklearn.linear_model import LogisticRegression, Ridge
+from sklearn.model_selection import GridSearchCV
+
+import jax.numpy as jnp
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.ops import metrics as M
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
+
+
+# ---------------------------------------------------------------------------
+# unit: jittable metrics vs sklearn on masked subsets
+# ---------------------------------------------------------------------------
+
+
+def _masked_case(n_classes=3, n=257, seed=0):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, n_classes, n)
+    p = rng.randint(0, n_classes, n)
+    w = (rng.rand(n) < 0.7).astype(np.float32)
+    keep = w > 0
+    return y, p, w, keep
+
+
+@pytest.mark.parametrize(
+    "scoring,sk_fn",
+    [
+        ("f1_macro", lambda y, p: __import__("sklearn.metrics", fromlist=["x"]).f1_score(y, p, average="macro")),
+        ("f1_micro", lambda y, p: __import__("sklearn.metrics", fromlist=["x"]).f1_score(y, p, average="micro")),
+        ("f1_weighted", lambda y, p: __import__("sklearn.metrics", fromlist=["x"]).f1_score(y, p, average="weighted")),
+        ("precision_macro", lambda y, p: __import__("sklearn.metrics", fromlist=["x"]).precision_score(y, p, average="macro", zero_division=0)),
+        ("recall_macro", lambda y, p: __import__("sklearn.metrics", fromlist=["x"]).recall_score(y, p, average="macro", zero_division=0)),
+        ("balanced_accuracy", lambda y, p: __import__("sklearn.metrics", fromlist=["x"]).balanced_accuracy_score(y, p)),
+    ],
+)
+def test_classification_scorers_match_sklearn(scoring, sk_fn):
+    y, p, w, keep = _masked_case()
+    ours = float(M.classification_score(scoring, jnp.asarray(y), jnp.asarray(p), jnp.asarray(w), 3))
+    ref = sk_fn(y[keep], p[keep])
+    assert abs(ours - ref) < 1e-6, (scoring, ours, ref)
+
+
+def test_binary_f1_precision_recall_match_sklearn():
+    from sklearn.metrics import f1_score, precision_score, recall_score
+
+    y, p, w, keep = _masked_case(n_classes=2, seed=3)
+    for scoring, fn in [
+        ("f1", f1_score),
+        ("precision", lambda a, b: precision_score(a, b, zero_division=0)),
+        ("recall", lambda a, b: recall_score(a, b, zero_division=0)),
+    ]:
+        ours = float(M.classification_score(scoring, jnp.asarray(y), jnp.asarray(p), jnp.asarray(w), 2))
+        assert abs(ours - fn(y[keep], p[keep])) < 1e-6, scoring
+
+
+def test_roc_auc_matches_sklearn_including_ties():
+    from sklearn.metrics import roc_auc_score
+
+    rng = np.random.RandomState(1)
+    y = rng.randint(0, 2, 301)
+    # quantized scores force ties across and within classes
+    s = np.round(rng.randn(301), 1).astype(np.float32)
+    w = (rng.rand(301) < 0.8).astype(np.float32)
+    keep = w > 0
+    ours = float(M.weighted_roc_auc_binary(jnp.asarray(y), jnp.asarray(s), jnp.asarray(w)))
+    ref = roc_auc_score(y[keep], s[keep])
+    assert abs(ours - ref) < 1e-6
+
+
+def test_regression_scorers_match_sklearn():
+    from sklearn.metrics import (
+        max_error,
+        mean_absolute_error,
+        mean_squared_error,
+    )
+
+    rng = np.random.RandomState(2)
+    y = rng.randn(200).astype(np.float32)
+    p = (y + 0.3 * rng.randn(200)).astype(np.float32)
+    w = (rng.rand(200) < 0.6).astype(np.float32)
+    keep = w > 0
+    cases = {
+        "neg_mean_squared_error": -mean_squared_error(y[keep], p[keep]),
+        "neg_root_mean_squared_error": -np.sqrt(mean_squared_error(y[keep], p[keep])),
+        "neg_mean_absolute_error": -mean_absolute_error(y[keep], p[keep]),
+        "max_error": -max_error(y[keep], p[keep]),
+    }
+    for scoring, ref in cases.items():
+        ours = float(M.regression_score(scoring, jnp.asarray(y), jnp.asarray(p), jnp.asarray(w)))
+        assert abs(ours - ref) < 1e-5, (scoring, ours, ref)
+
+
+def test_validate_scoring_rejects_unknown_and_callables():
+    with pytest.raises(ValueError, match="unsupported scoring"):
+        M.validate_scoring("not_a_scorer", "classification")
+    with pytest.raises(ValueError, match="callable"):
+        M.validate_scoring(lambda est, X, y: 0.0, "classification")
+    with pytest.raises(ValueError, match="unsupported scoring"):
+        M.validate_scoring("roc_auc", "regression")
+    M.validate_scoring("f1_macro", "classification")  # no raise
+    M.validate_scoring(None, "regression")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: best_params_ parity under custom scoring
+# ---------------------------------------------------------------------------
+
+
+def _stage_csv(df, name):
+    from cs230_distributed_machine_learning_tpu.data.datasets import dataset_dir
+
+    base = dataset_dir(name)
+    pre = os.path.join(base, "preprocessed")
+    os.makedirs(pre, exist_ok=True)
+    df.to_csv(os.path.join(pre, f"{name}_preprocessed.csv"), index=False)
+
+
+def _imbalanced_binary(n=600, seed=11):
+    import pandas as pd
+
+    X, y = make_classification(
+        n_samples=n,
+        n_features=8,
+        n_informative=5,
+        weights=[0.85, 0.15],
+        flip_y=0.08,
+        class_sep=0.6,
+        random_state=seed,
+    )
+    df = pd.DataFrame(X.astype(np.float32), columns=[f"f{i}" for i in range(8)])
+    df["target"] = y
+    return df, X, y
+
+
+@pytest.mark.parametrize("scoring", ["f1_macro", "roc_auc", "balanced_accuracy"])
+def test_grid_search_scoring_parity_classification(scoring):
+    df, X, y = _imbalanced_binary()
+    _stage_csv(df, "imb")
+    grid = {"C": [0.001, 0.01, 0.1, 1.0, 10.0], "fit_intercept": [True, False]}
+    search = GridSearchCV(LogisticRegression(max_iter=500), grid, cv=5, scoring=scoring)
+
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(search, "imb", {"random_state": 0}, show_progress=False)
+    assert status["job_status"] == "completed"
+    results = status["job_result"]["results"]
+    assert len(results) == 10
+
+    sk = GridSearchCV(
+        LogisticRegression(max_iter=500), grid, cv=5, scoring=scoring
+    ).fit(X, y)
+
+    ours = {
+        (r["parameters"]["C"], r["parameters"]["fit_intercept"]): r["mean_cv_score"]
+        for r in results
+    }
+    for params, mean_score in zip(
+        sk.cv_results_["params"], sk.cv_results_["mean_test_score"]
+    ):
+        key = (params["C"], params["fit_intercept"])
+        assert abs(ours[key] - mean_score) < 0.02, (key, ours[key], mean_score)
+
+    best = status["job_result"]["best_result"]
+    assert best["parameters"]["C"] == sk.best_params_["C"]
+    assert best["parameters"]["fit_intercept"] == sk.best_params_["fit_intercept"]
+    # the holdout metric is reported under the scorer's name
+    assert scoring in best
+
+
+def test_scoring_changes_the_winner():
+    """The point of honoring scoring: on imbalanced data the f1_macro
+    winner differs from the accuracy winner for a C-grid that trades
+    minority-class recall for raw accuracy."""
+    df, X, y = _imbalanced_binary(seed=42)
+    _stage_csv(df, "imb2")
+    grid = {"C": [1e-4, 3e-4, 1e-3, 3e-3, 1e-2, 0.1, 1.0]}
+
+    sk_acc = GridSearchCV(LogisticRegression(max_iter=500), grid, cv=5).fit(X, y)
+    sk_f1 = GridSearchCV(
+        LogisticRegression(max_iter=500), grid, cv=5, scoring="f1_macro"
+    ).fit(X, y)
+    assert sk_acc.best_params_ != sk_f1.best_params_  # the draw separates them
+
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(
+        GridSearchCV(LogisticRegression(max_iter=500), grid, cv=5, scoring="f1_macro"),
+        "imb2",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    best = status["job_result"]["best_result"]
+    assert best["parameters"]["C"] == sk_f1.best_params_["C"]
+    assert best["parameters"]["C"] != sk_acc.best_params_["C"]
+
+
+def test_grid_search_scoring_parity_regression():
+    import pandas as pd
+
+    X, y = make_regression(
+        n_samples=400, n_features=10, noise=25.0, random_state=5
+    )
+    df = pd.DataFrame(X.astype(np.float32), columns=[f"f{i}" for i in range(10)])
+    df["target"] = y.astype(np.float32)
+    _stage_csv(df, "regds")
+    grid = {"alpha": [0.01, 0.1, 1.0, 10.0, 100.0, 1000.0]}
+    scoring = "neg_mean_absolute_error"
+
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(
+        GridSearchCV(Ridge(), grid, cv=5, scoring=scoring),
+        "regds",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    assert status["job_status"] == "completed"
+
+    sk = GridSearchCV(Ridge(), grid, cv=5, scoring=scoring).fit(X, y)
+    best = status["job_result"]["best_result"]
+    assert best["parameters"]["alpha"] == sk.best_params_["alpha"]
+    ours = {r["parameters"]["alpha"]: r["mean_cv_score"] for r in status["job_result"]["results"]}
+    for params, mean_score in zip(
+        sk.cv_results_["params"], sk.cv_results_["mean_test_score"]
+    ):
+        ref = mean_score
+        got = ours[params["alpha"]]
+        assert abs(got - ref) < max(0.02 * abs(ref), 0.05), (params, got, ref)
+
+
+def test_margin_scorers_across_kernel_families():
+    """roc_auc rides each family's natural margin (logits, proba diff,
+    decision function) and matches sklearn's predict_proba/decision ranking."""
+    from sklearn.ensemble import RandomForestClassifier
+    from sklearn.naive_bayes import GaussianNB
+    from sklearn.metrics import roc_auc_score
+    from sklearn.model_selection import cross_val_score
+
+    df, X, y = _imbalanced_binary(300, seed=5)
+    _stage_csv(df, "imbm")
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    for est, grid in [
+        (GaussianNB(), {"var_smoothing": [1e-9, 1e-7]}),
+        (RandomForestClassifier(n_estimators=20, random_state=0), {"max_depth": [3, 5]}),
+    ]:
+        status = manager.train(
+            GridSearchCV(est, grid, cv=3, scoring="roc_auc"),
+            "imbm",
+            {"random_state": 0},
+            show_progress=False,
+        )
+        assert status["job_status"] == "completed", type(est).__name__
+        for r in status["job_result"]["results"]:
+            assert r["status"] == "completed"
+            assert 0.5 < r["mean_cv_score"] <= 1.0, (type(est).__name__, r)
+        # NB is deterministic: CV AUCs should match sklearn closely
+        if isinstance(est, GaussianNB):
+            ref = cross_val_score(est, X, y, cv=3, scoring="roc_auc").mean()
+            best = status["job_result"]["best_result"]["mean_cv_score"]
+            assert abs(best - ref) < 0.02, (best, ref)
+
+
+def test_binary_only_scorers_rejected_on_multiclass():
+    """sklearn raises for average='binary' and roc_auc on multiclass; so do
+    we — at submission, not as a silent class0-vs-class1 ranking."""
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    for scoring in ["f1", "precision", "recall", "roc_auc"]:
+        status = manager.train(
+            GridSearchCV(LogisticRegression(max_iter=200), {"C": [1.0]}, cv=3,
+                         scoring=scoring),
+            "iris",  # 3 classes
+            {"random_state": 0},
+            show_progress=False,
+        )
+        failed = status["job_result"]["failed"]
+        assert failed, scoring
+        assert any("binary-only" in str(r.get("error", "")) for r in failed), scoring
+
+
+def test_margin_scorer_rejected_for_label_only_kernel():
+    from sklearn.neighbors import KNeighborsClassifier
+
+    df, _, _ = _imbalanced_binary(200, seed=9)
+    _stage_csv(df, "imbk")
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(
+        GridSearchCV(KNeighborsClassifier(), {"n_neighbors": [3]}, cv=3,
+                     scoring="roc_auc"),
+        "imbk",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    failed = status["job_result"]["failed"]
+    assert failed
+    assert any("decision margin" in str(r.get("error", "")) for r in failed)
+
+
+def test_transform_scoring_rejected():
+    from sklearn.decomposition import PCA
+
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(
+        GridSearchCV(PCA(), {"n_components": [2]}, cv=3, scoring="f1_macro"),
+        "iris",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    failed = status["job_result"]["failed"]
+    assert failed
+    assert any("not applicable" in str(r.get("error", "")) for r in failed)
+
+
+def test_unsupported_scoring_fails_loudly():
+    df, _, _ = _imbalanced_binary(200)
+    _stage_csv(df, "imb3")
+    manager = MLTaskManager(coordinator=Coordinator(mesh=trial_mesh()))
+    status = manager.train(
+        GridSearchCV(LogisticRegression(), {"C": [1.0]}, cv=3, scoring="nope_score"),
+        "imb3",
+        {"random_state": 0},
+        show_progress=False,
+    )
+    assert status["job_result"]["results"] == []
+    failed = status["job_result"]["failed"]
+    assert failed and all(r.get("status") == "failed" for r in failed)
+    assert any("unsupported scoring" in str(r.get("error", "")) for r in failed)
