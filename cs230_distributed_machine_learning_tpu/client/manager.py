@@ -224,6 +224,18 @@ class MLTaskManager:
             f.write(r.content)
         return out
 
+    def load_best_model(self, job_id: Optional[str] = None, as_sklearn: bool = True):
+        """Download the winning artifact and load it — by default as a real
+        fitted sklearn estimator (state-injected; runtime/sklearn_export.py),
+        matching the reference's serve-a-sklearn-pickle contract
+        (worker.py:352-356, master.py:270-291). ``as_sklearn=False`` returns
+        the raw kernel artifact dict for ``predict_with_artifact``."""
+        from ..runtime.artifacts import load_artifact, to_sklearn
+
+        path = self.download_best_model(job_id)
+        artifact = load_artifact(path)
+        return to_sklearn(artifact) if as_sklearn else artifact
+
     # ------------- REST plumbing -------------
 
     def _request(self, method: str, endpoint: str, json=None, params=None) -> Dict[str, Any]:

@@ -5,8 +5,9 @@ Parity target: the reference pickles each fitted sklearn estimator to
 ``/download_model`` (``worker.py:352-356``, ``master.py:270-291``). Here the
 artifact is a plain dict of numpy arrays + config (no arbitrary-code
 pickle), written with ``pickle`` for wire parity but loadable into either
-our kernels or, for supported linear models, an equivalent sklearn
-estimator for users migrating off the reference.
+our kernels (``predict_with_artifact``) or a real state-injected sklearn
+estimator for EVERY model family (``to_sklearn``, runtime/sklearn_export.py)
+for users migrating off the reference.
 """
 
 from __future__ import annotations
@@ -53,3 +54,11 @@ def jnp_tree(tree):
     import jax.numpy as jnp
 
     return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def to_sklearn(artifact: Dict[str, Any]):
+    """Construct the equivalent fitted sklearn estimator (state injection;
+    see runtime/sklearn_export.py for the per-family contracts)."""
+    from .sklearn_export import to_sklearn as _to_sklearn
+
+    return _to_sklearn(artifact)
