@@ -184,6 +184,13 @@ class LocalExecutor:
                 finished_at = time.time()
                 resources = sampler.averages()
                 per_trial_time = run.run_time_s / max(len(idxs), 1)
+                # winner-by-ICI-collective: run_trials' on-device argmax over
+                # the mesh-sharded scores (multi-device only). The marked
+                # result lets the coordinator select the winner from the
+                # device reduction instead of a host sort.
+                device_best_pos = (
+                    run.device_best[0] if run.device_best is not None else None
+                )
                 for j, gi in enumerate(idxs):
                     st = subtasks[gi]
                     result = {
@@ -196,6 +203,8 @@ class LocalExecutor:
                         "status": "completed",
                         **run.trial_metrics[j],
                     }
+                    if device_best_pos == j:
+                        result["device_argmax"] = True
                     results[gi] = result
                     if on_result:
                         on_result(st["subtask_id"], "completed", result)
@@ -329,24 +338,33 @@ def _is_device_fatal(e: BaseException) -> bool:
 class FaultInjector:
     """Test/chaos hooks (SURVEY.md §5.3: 'add real fault injection hooks'):
     delay a host's batches, fail N batches (task-level), drop results
-    silently, or poison the device backend (process-level)."""
+    silently, or poison the device backend (process-level) — immediately or
+    after N healthy batches (``device_lost_after``, the kill-mid-job chaos
+    scenario)."""
 
     def __init__(self, delay_s: float = 0.0, fail_batches: int = 0,
-                 device_lost: bool = False):
+                 device_lost: bool = False,
+                 device_lost_after: Optional[int] = None):
         self.delay_s = delay_s
         self.fail_batches = fail_batches
         self.device_lost = device_lost
+        self.device_lost_after = device_lost_after
+        self._batches_seen = 0
 
     def before_batch(self, executor_id: str, model_type: str) -> None:
         if self.delay_s > 0:
             time.sleep(self.delay_s)
-        if self.device_lost:
+        if self.device_lost or (
+            self.device_lost_after is not None
+            and self._batches_seen >= self.device_lost_after
+        ):
             raise DeviceLostError(
                 f"fault injection: simulated backend loss on {executor_id}"
             )
         if self.fail_batches > 0:
             self.fail_batches -= 1
             raise RuntimeError(f"fault injection: simulated batch failure on {executor_id}")
+        self._batches_seen += 1  # only batches that passed injection count
 
 
 def _np(y):
