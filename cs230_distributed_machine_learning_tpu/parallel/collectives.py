@@ -8,8 +8,15 @@ inserts the all-gather/reduce over ICI (the BASELINE.json north star:
 "cross-worker CV-fold aggregation uses XLA all-gather over ICI instead of
 HTTP/S3 round-trips"). Host code receives only the winning scalar/index.
 
-Also provides shard_map-based helpers used by tests to pin down the exact
-collective semantics on a virtual mesh.
+The PRODUCTION in-job path lives in the trial engine itself:
+``trial_map._chunk_best`` reduces every sharded dispatch's score chunk on
+device, the executor marks the winner (``device_argmax``), and the
+coordinator selects ``best_result`` from that reduction
+(``winner_via == "ici_argmax"``). The helpers here serve device-resident
+score vectors outside the engine and pin down collective semantics in
+tests; ``best_trial`` deliberately routes small HOST-side lists to a host
+argmax — dispatching a device program to reduce a few collected floats
+would pay an RPC round trip for nothing.
 """
 
 from __future__ import annotations

