@@ -30,11 +30,22 @@ def download_dataset(
 
     if dataset_type == "kaggle":
         try:
-            import kaggle  # noqa: F401
-
-            kaggle.api.dataset_download_files(dataset_url, path=target, unzip=True)
+            import kaggle
         except ImportError as e:
             raise RuntimeError("kaggle package not available in this environment") from e
+        except OSError as e:
+            # the kaggle client authenticates at import time and raises
+            # OSError when no credentials resolve; surface the deployment
+            # story instead of a bare config error. (Download-time errors —
+            # network, disk — propagate untouched below.)
+            raise RuntimeError(
+                "kaggle credentials not found: set KAGGLE_USERNAME/KAGGLE_KEY "
+                "in the coordinator's environment or mount kaggle.json "
+                "(KAGGLE_CONFIG_DIR) — see deploy/compose.yaml and "
+                "deploy/tpu_vm_fleet.md (credentials are never baked into "
+                "images, unlike the reference's Master.Dockerfile)"
+            ) from e
+        kaggle.api.dataset_download_files(dataset_url, path=target, unzip=True)
     elif dataset_type in ("huggingface", "hf"):
         try:
             from datasets import load_dataset
