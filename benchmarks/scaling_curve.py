@@ -129,7 +129,11 @@ def main() -> None:
             return dt, result["best_result"].get("mean_cv_score")
 
         wall, ours_cv = _timed_ok()
-        steady, _ = _timed_ok()
+        # steady = best of two post-compile passes: tunnel-link stalls are
+        # one-sided additive noise (same rationale as bench.py's fastest-3
+        # window), and a single noisy second pass once recorded a "steady"
+        # 1.7x above the first pass
+        steady = min(_timed_ok()[0] for _ in range(2))
 
         report.append(
             {

@@ -104,12 +104,13 @@ class _MLPBase(ModelKernel):
             params.append({"W": W, "b": jnp.zeros((fan_out,), jnp.float32)})
         return params
 
-    def _forward(self, params, X, static):
+    def _forward(self, params, X, static, mm=None):
         act = _act(static.get("activation", "relu"))
+        mm = mm or jnp.matmul
         h = X
         for layer in params[:-1]:
-            h = act(h @ layer["W"] + layer["b"])
-        return h @ params[-1]["W"] + params[-1]["b"]
+            h = act(mm(h, layer["W"]) + layer["b"])
+        return mm(h, params[-1]["W"]) + params[-1]["b"]
 
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
         X = X.astype(jnp.float32)
@@ -130,10 +131,26 @@ class _MLPBase(ModelKernel):
         params = self._init(init_key, dims)
         target = self._target(y, static)
 
+        # bf16 matmuls (f32 accumulation) for the fwd/bwd passes — the MXU's
+        # native mode; and a bf16 FIRST moment. The fit is Adam-STATE-
+        # bandwidth bound, not compute bound (params+m+v stream from HBM
+        # every step while each step's matmul touches only batch_size rows),
+        # so shrinking moment bytes matters more than the matmul rate.
+        # The second moment v MUST stay f32: beta2=0.999 makes per-step
+        # updates ~0.1% of v, below bf16's ~0.4% round-to-nearest deadband —
+        # a bf16 v freezes at stale values and silently suppresses the
+        # effective step size (m's beta1=0.9 steps are ~25x the deadband,
+        # safe in bf16).
+        def mm(a, b):
+            return jnp.matmul(
+                a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                preferred_element_type=jnp.float32,
+            )
+
         def loss_fn(p, xb, tb, wb):
             # sklearn scaling: mean batch loss + alpha/2 * ||W||^2 / batch size,
             # with split-mask weights zeroing out-of-split rows
-            pred = self._forward(p, xb, static)
+            pred = self._forward(p, xb, static, mm=mm)
             batch_w = jnp.maximum(jnp.sum(wb), 1e-12)
             data_loss = jnp.sum(self._loss(pred, tb) * wb) / batch_w
             l2 = sum(jnp.sum(layer["W"] ** 2) for layer in p)
@@ -141,7 +158,8 @@ class _MLPBase(ModelKernel):
 
         grad_fn = jax.grad(loss_fn)
 
-        m0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        bf16 = jnp.bfloat16
+        m0 = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a, bf16), params)
         v0 = jax.tree_util.tree_map(jnp.zeros_like, params)
 
         def step(carry, inp):
@@ -152,9 +170,14 @@ class _MLPBase(ModelKernel):
             wb = w[idx]
             g = grad_fn(p, xb, tb, wb)
             t = t + 1.0
-            m = jax.tree_util.tree_map(lambda a, b: b1 * a + (1 - b1) * b, m, g)
-            v = jax.tree_util.tree_map(lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
-            mhat = jax.tree_util.tree_map(lambda a: a / (1 - b1**t), m)
+            # moment math in f32, storage in bf16 (carry dtype)
+            m = jax.tree_util.tree_map(
+                lambda a, b: (b1 * a.astype(jnp.float32) + (1 - b1) * b
+                              ).astype(bf16), m, g)
+            v = jax.tree_util.tree_map(
+                lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
+            mhat = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32) / (1 - b1**t), m)
             vhat = jax.tree_util.tree_map(lambda a: a / (1 - b2**t), v)
             p = jax.tree_util.tree_map(
                 lambda a, mh, vh: a - lr * mh / (jnp.sqrt(vh) + eps), p, mhat, vhat

@@ -74,8 +74,23 @@ _DEEP_LEVELS_EXPLICIT = 32
 # frontier back at unchanged cv. The width formula itself scales with n
 # (2^ceil(log2(n/64))), so this cap only binds past ~33k rows — small
 # fractions keep their narrower, faster arenas. Env-tunable for sweeps.
-_DEEP_W = int(os.environ.get("CS230_DEEP_W", "512"))
+#
+# r4: per-level histogram cost is ~ W x n_bins, so width and bins TRADE at
+# constant cost — and at full Covertype the trade strongly favors width.
+# (The sklearn denominator was re-measured UNCONTENDED at 413.9-420.2 s /
+# cv 0.8400 — the r3 613.7/672.5 s figures were CPU-contended; see
+# BASELINE.md r4. First-pass times unless noted.)
+#   W=768  nb=32 cv 0.8295   W=896 nb=28 cv 0.8318
+#   W=1024 nb=24 cv 0.8328 (231.9 s steady = 1.80x vs honest 417 s)
+#   W=1024 nb=16 cv 0.8309 (206.6 s steady = 2.02x)
+#   W=1536 nb=16 cv 0.8366 (286.7 s)   W=2048 nb=12 cv 0.8365 (saturates)
+# The top width band therefore pairs W=1024 with 24 bins; the narrower
+# bands keep the 48-bin cap their parity anchors were measured at.
+_DEEP_W = int(os.environ.get("CS230_DEEP_W", "1024"))
 _DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "48"))
+#: bins cap when the TOP width band is in play (n > 49152): the measured
+#: constant-cost width/bins trade above
+_DEEP_BINS_WIDE = int(os.environ.get("CS230_DEEP_BINS_WIDE", "24"))
 
 
 _deep_w_force_warned: set = set()
@@ -96,20 +111,21 @@ def _warn_deep_w_force(width: int) -> None:
 _deep_bins_warned: set = set()
 
 
-def _warn_deep_bins_clamp(requested: int) -> None:
+def _warn_deep_bins_clamp(requested: int, cap: int) -> None:
     """Once-per-process notice that the deep arena overrides an explicitly
-    requested finer n_bins (CS230_DEEP_BINS cap) — callers otherwise can't
-    detect the divergence (ADVICE r2)."""
-    if requested in _deep_bins_warned:
+    requested finer n_bins (CS230_DEEP_BINS / CS230_DEEP_BINS_WIDE caps) —
+    callers otherwise can't detect the divergence (ADVICE r2)."""
+    if (requested, cap) in _deep_bins_warned:
         return
-    _deep_bins_warned.add(requested)
+    _deep_bins_warned.add((requested, cap))
     from ..utils import get_logger
 
     get_logger().warning(
         "deep-tree arena clamps requested n_bins=%d to %d "
-        "(CS230_DEEP_BINS; large-n grow-to-purity path only)",
+        "(CS230_DEEP_BINS / CS230_DEEP_BINS_WIDE; large-n grow-to-purity "
+        "path only)",
         requested,
-        _DEEP_BINS_CAP,
+        cap,
     )
 
 
@@ -172,11 +188,15 @@ class _TreeBase(ModelKernel):
             # Width by explicit monotone bands anchored at on-device
             # parity measurements (Covertype RF-100, CV delta vs sklearn
             # in parens): 5.8k->128 (+0.003), 11.6k->128 (-0.006, 10.6 s
-            # = 3.0x sklearn), 29k->256 (-0.007), 58k->512 (-0.007),
-            # 116k->512-capped (-0.018). Band edges sit between measured
-            # points, so every n gets the narrowest width whose band
-            # endpoints sat inside the 0.01 parity band; test-scale deep
-            # fits (n just over the 4096 threshold) keep 64-wide arenas.
+            # = 3.0x sklearn), 29k->256 (-0.007), 58k/116k->1024@24bins
+            # (-0.0072 at 116k vs the honest 0.8400 denominator, 231.9 s
+            # steady — the r4 width/bins trade, sweep table at _DEEP_W;
+            # the 58k row BEATS sklearn: 0.8121 vs 0.8113). Band edges sit
+            # between measured points, so every n gets the narrowest width
+            # whose band endpoints sat inside the 0.01 parity band;
+            # test-scale deep fits (n just over the 4096 threshold) keep
+            # 64-wide arenas.
+            bins_cap = _DEEP_BINS_CAP
             force_w = os.environ.get("CS230_DEEP_W_FORCE")
             if force_w:
                 # sweep/parity hook: bypass the width bands entirely (the
@@ -201,16 +221,23 @@ class _TreeBase(ModelKernel):
                 elif n <= 49152:
                     width = 256
                 else:
-                    width = 512
+                    width = 1024
                 width = min(_DEEP_W, width)
+                if width >= 1024:
+                    # top band: trade bins for width at constant histogram
+                    # cost (W x n_bins) — measured strictly better CV. Only
+                    # when the wide arena is actually in play (a user pinning
+                    # CS230_DEEP_W to a narrower arena keeps the 48-bin cap
+                    # its parity points were measured at).
+                    bins_cap = min(bins_cap, _DEEP_BINS_WIDE)
             depth = levels
             # coarser quantile bins in the deep arena (see sweep table at
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
             # like the depth caps, this deliberately overrides a finer
             # user-requested binning for the deep path only
-            if "n_bins" in static and n_bins > _DEEP_BINS_CAP:
-                _warn_deep_bins_clamp(n_bins)
-            n_bins = min(n_bins, _DEEP_BINS_CAP)
+            if "n_bins" in static and n_bins > bins_cap:
+                _warn_deep_bins_clamp(n_bins, bins_cap)
+            n_bins = min(n_bins, bins_cap)
         elif depth is None:
             # small data: the complete-tree builder to ~log2(n) levels is
             # already near-purity and cheaper to compile than the arena
