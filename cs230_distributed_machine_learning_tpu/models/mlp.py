@@ -92,6 +92,21 @@ class _MLPBase(ModelKernel):
         n_batches = max(1, n // bs)
         return 3.0 * static["_epochs"] * n_batches * bs * layer_macs
 
+    def memory_estimate_mb(self, n, d, static):
+        """Marginal per-(trial, split) working set: params + Adam moments +
+        per-step batch activations — NOT the [n, d] dataset (shared across
+        lanes, counted once by the engine). The base-class default charged
+        each lane ~3x the dataset (~0.5 GB at MNIST scale), capping
+        dispatches at ~2 trials and costing ~50 RPC round trips per job
+        plus tiny-lane matmuls; the true footprint is a few MB, so the
+        whole search fits one dispatch with hundreds of vmapped lanes."""
+        dims = self._dims(d, static)
+        wparams = sum(a * b + b for a, b in zip(dims[:-1], dims[1:]))
+        bs = int(static.get("_bs", 200))
+        state_mb = 3.0 * wparams * 4 / 1e6  # params + m + v (v f32, m bf16)
+        act_mb = 3.0 * bs * sum(dims) * 4 / 1e6  # fwd+bwd live activations
+        return max(1.0, state_mb + act_mb + 1.0)
+
     def _init(self, key, dims):
         """sklearn's Glorot-uniform init."""
         params = []

@@ -79,9 +79,9 @@ class _DecisionTreeBase(_TreeBase):
     }
     _mf_default = 1.0
 
-    def _fit_tree(self, xb, S, C, static):
+    def _fit_tree(self, X, S, C, static):
         return self._fit_one_tree(
-            xb, S, C, static,
+            X, S, C, static,
             jax.random.PRNGKey(static["_seed"]),
             jax.lax.Precision.HIGHEST,
         )
@@ -92,11 +92,10 @@ class DecisionTreeClassifierKernel(_DecisionTreeBase):
     task = "classification"
 
     def fit(self, X, y, w, hyper, static):
-        xb = X["xb"] if isinstance(X, dict) else X
         c = max(int(static["_n_classes"]), 2)
         w = w.astype(jnp.float32)
         S = jax.nn.one_hot(y, c, dtype=jnp.float32) * w[:, None]
-        params = {"tree": self._fit_tree(xb, S, w, static)}
+        params = {"tree": self._fit_tree(X, S, w, static)}
         if isinstance(X, dict):
             params["edges"] = X["edges"]
         return params
@@ -117,10 +116,9 @@ class DecisionTreeRegressorKernel(_DecisionTreeBase):
     task = "regression"
 
     def fit(self, X, y, w, hyper, static):
-        xb = X["xb"] if isinstance(X, dict) else X
         w = w.astype(jnp.float32)
         S = (y.astype(jnp.float32) * w)[:, None]
-        params = {"tree": self._fit_tree(xb, S, w, static)}
+        params = {"tree": self._fit_tree(X, S, w, static)}
         if isinstance(X, dict):
             params["edges"] = X["edges"]
         return params

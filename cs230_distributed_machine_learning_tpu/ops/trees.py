@@ -42,10 +42,21 @@ def quantile_bins(X: np.ndarray, n_bins: int) -> np.ndarray:
     """Host-side: per-feature bin edges (n_bins-1 interior cutpoints) from
     quantiles of the full dataset. Computed once per dataset+n_bins and
     shared by every trial/fold (the reference re-reads and re-sorts data
-    per subtask; here binning is a one-time cost)."""
+    per subtask; here binning is a one-time cost).
+
+    Duplicate quantiles (low-cardinality features — e.g. one-hot columns,
+    where most quantiles coincide) are DEDUPED per feature and the tail
+    padded with +inf: the distinct cut set is unchanged (identical split
+    candidates), but bin codes become compact ([0, n_distinct]), which is
+    what lets the deep builder histogram low-cardinality features in a
+    narrow-bin group (see build_tree_deep ``groups``)."""
     qs = np.linspace(0, 1, n_bins + 1)[1:-1]
-    edges = np.quantile(X, qs, axis=0)  # [n_bins-1, d]
-    return np.ascontiguousarray(edges.T.astype(np.float32))  # [d, n_bins-1]
+    edges = np.quantile(X, qs, axis=0).T  # [d, n_bins-1]
+    out = np.full(edges.shape, np.inf, np.float32)
+    for f in range(edges.shape[0]):
+        u = np.unique(edges[f])  # sorted, deduped
+        out[f, : len(u)] = u
+    return np.ascontiguousarray(out)
 
 
 @jax.jit
@@ -64,23 +75,31 @@ def bin_data(X, edges) -> jnp.ndarray:
 _HIST_ROW_CHUNK = 16384
 
 
-def _level_histogram(local, xb, SC, n_nodes: int, n_bins: int, precision=None,
-                     integer_stats: bool = False):
-    """[n_nodes, d, n_bins, kk] histogram of per-sample stats ``SC`` grouped
-    by (tree node, feature, bin code).
+def _level_histogram_multi(local, xbs, SC, n_nodes: int, n_binss,
+                           precision=None, integer_stats: bool = False):
+    """Feature-grouped level histograms in ONE row scan: a tuple of
+    [n_nodes, d_g, nb_g, kk] histograms, one per (xb_g, nb_g) feature group.
 
-    Computed as (one_hot(node) ⊗ SC)ᵀ @ one_hot(bins) over row chunks: two
+    Computed as (one_hot(node) ⊗ SC)ᵀ @ one_hot(bins_g) over row chunks: two
     0/1 one-hot operands make the contraction a pure MXU matmul, replacing
     segment-sum scatters (which serialize on TPU and dominated tree-fit time
     ~10-30x). Rows stream through a lax.scan so peak memory is
-    O(row_chunk · (n_nodes·kk + d·n_bins)) regardless of n.
+    O(row_chunk · (n_nodes·kk + sum d_g·nb_g)) regardless of n.
+
+    The left operand T1 = one_hot(node) ⊗ SC ([row_chunk, n_nodes*kk], the
+    histogram's dominant memory-traffic term at wide frontiers) is built
+    ONCE per chunk and contracted against every group's bin one-hot — this
+    is why grouped histograms fuse into one scan instead of calling a
+    single-group kernel per group (an A/B of the two-scan form measured NO
+    win: the duplicated T1 traffic ate the narrower matmuls' savings).
 
     ``integer_stats``: the stat columns are small non-negative integers
     (< 128 — classification one-hots times bootstrap counts, which
     _bootstrap_counts caps): run the contraction as s8 x s8 -> s32 on the
     MXU (2x the bf16 rate on v5e), bit-exact by construction.
     """
-    n, d = xb.shape
+    n = xbs[0].shape[0]
+    ds = tuple(xb.shape[1] for xb in xbs)
     kk = SC.shape[1]
     rc = min(_HIST_ROW_CHUNK, n)
     n_pad = ((n + rc - 1) // rc) * rc
@@ -88,7 +107,7 @@ def _level_histogram(local, xb, SC, n_nodes: int, n_bins: int, precision=None,
         # padded rows carry zero stats — they land in node 0/bin 0 cells
         # with zero contribution
         local = jnp.pad(local, (0, n_pad - n))
-        xb = jnp.pad(xb, ((0, n_pad - n), (0, 0)))
+        xbs = tuple(jnp.pad(xb, ((0, n_pad - n), (0, 0))) for xb in xbs)
         SC = jnp.pad(SC, ((0, n_pad - n), (0, 0)))
 
     # Integer stats under DEFAULT precision ride the s8 MXU path (2x bf16
@@ -102,30 +121,48 @@ def _level_histogram(local, xb, SC, n_nodes: int, n_bins: int, precision=None,
     op_dt = jnp.int8 if int8_path else SC.dtype
     acc_dt = jnp.int32 if int8_path else jnp.float32
 
-    def body(H, start):
+    def body(Hs, start):
         lb = jax.lax.dynamic_slice(local, (start,), (rc,))
-        xbb = jax.lax.dynamic_slice(xb, (start, 0), (rc, d))
         SCb = jax.lax.dynamic_slice(SC, (start, 0), (rc, kk)).astype(op_dt)
         N = jax.nn.one_hot(lb, n_nodes, dtype=op_dt)  # [rc, nodes]
         T1 = (N[:, :, None] * SCb[:, None, :]).reshape(rc, n_nodes * kk)
-        B = (
-            xbb[:, :, None] == jnp.arange(n_bins, dtype=xbb.dtype)[None, None, :]
-        ).astype(op_dt).reshape(rc, d * n_bins)
-        H = H + jnp.dot(
-            T1.T,
-            B,
-            precision=None if int8_path else precision,
-            preferred_element_type=acc_dt,
-        )
-        return H, None
+        out = []
+        for H, xb, d, n_bins in zip(Hs, xbs, ds, n_binss):
+            xbb = jax.lax.dynamic_slice(xb, (start, 0), (rc, d))
+            B = (
+                xbb[:, :, None]
+                == jnp.arange(n_bins, dtype=xbb.dtype)[None, None, :]
+            ).astype(op_dt).reshape(rc, d * n_bins)
+            out.append(H + jnp.dot(
+                T1.T,
+                B,
+                precision=None if int8_path else precision,
+                preferred_element_type=acc_dt,
+            ))
+        return tuple(out), None
 
-    H0 = jnp.zeros((n_nodes * kk, d * n_bins), acc_dt)
-    starts = jnp.arange(0, n_pad, rc, dtype=jnp.int32)
-    H, _ = jax.lax.scan(body, H0, starts)
-    # rows are node-major over kk; cols feature-major over bins
-    return H.astype(jnp.float32).reshape(n_nodes, kk, d, n_bins).transpose(
-        0, 2, 3, 1
+    H0 = tuple(
+        jnp.zeros((n_nodes * kk, d * n_bins), acc_dt)
+        for d, n_bins in zip(ds, n_binss)
     )
+    starts = jnp.arange(0, n_pad, rc, dtype=jnp.int32)
+    Hs, _ = jax.lax.scan(body, H0, starts)
+    # rows are node-major over kk; cols feature-major over bins
+    return tuple(
+        H.astype(jnp.float32).reshape(n_nodes, kk, d, n_bins).transpose(
+            0, 2, 3, 1
+        )
+        for H, d, n_bins in zip(Hs, ds, n_binss)
+    )
+
+
+def _level_histogram(local, xb, SC, n_nodes: int, n_bins: int, precision=None,
+                     integer_stats: bool = False):
+    """Single-group form of ``_level_histogram_multi`` (same contract as
+    always: [n_nodes, d, n_bins, kk])."""
+    return _level_histogram_multi(
+        local, (xb,), SC, n_nodes, (n_bins,), precision, integer_stats
+    )[0]
 
 
 #: compact-histogram geometry (sparsity-exploiting level histograms below).
@@ -385,44 +422,60 @@ def _leaf_select(leaf_local, V, n_leaves: int):
     return jnp.dot(oh, V, precision=jax.lax.Precision.HIGHEST)
 
 
-def _node_feature_mask(gain, node_ids, key, max_features: Optional[int], d: int):
-    """RF per-node feature subsets for the deep builder, keyed by arena node
-    id (fold_in) so chunked/monolithic fits draw identical subsets."""
+def _feature_subset_allowed(node_ids, key, max_features: Optional[int], d: int):
+    """[m, d] bool mask of each node's random feature subset (or None when
+    all features are allowed), keyed by arena node id (fold_in) so chunked/
+    monolithic fits draw identical subsets. The mask is computed over the
+    GLOBAL feature space so grouped-histogram builds (which slice it per
+    group) sample the same subsets as ungrouped builds."""
     if max_features is None or max_features >= d:
-        return gain
+        return None
 
     def one(cid):
         return jax.random.uniform(jax.random.fold_in(key, cid), (d,))
 
     u = jax.vmap(one)(jnp.maximum(node_ids, 0))
     thresh = jnp.sort(u, axis=1)[:, max_features - 1 : max_features]
-    allowed = u <= thresh
-    return jnp.where(allowed[:, :, None], gain, -jnp.inf)
+    return u <= thresh
+
+
+
+
+def _hist_with_count_multi(local, xbs, SC, n_nodes, n_binss, precision, k,
+                           count_from_stats: bool):
+    """Feature-grouped level histograms, each [m, d_g, nb_g, k+1], in one
+    row scan. When the stat columns sum to the count column exactly
+    (classification: S = one_hot(y) * w, C = w), the count histogram is
+    derived as the sum over class histograms instead of contracting an
+    extra column — one fewer MXU row per node, exact."""
+    if not count_from_stats:
+        return _level_histogram_multi(local, xbs, SC, n_nodes, n_binss, precision)
+    # count_from_stats == classification: stats are one_hot(y) x integer
+    # bootstrap/fold counts (< 128 by _bootstrap_counts' cap) — the s8 MXU
+    # path applies
+    Hs = _level_histogram_multi(local, xbs, SC[:, :k], n_nodes, n_binss,
+                                precision, integer_stats=True)
+    return tuple(
+        jnp.concatenate([H, jnp.sum(H, axis=-1, keepdims=True)], axis=-1)
+        for H in Hs
+    )
 
 
 def _hist_with_count(local, xb, SC, n_nodes, n_bins, precision, k,
                      count_from_stats: bool):
-    """Level histogram [m, d, nb, k+1]. When the stat columns sum to the
-    count column exactly (classification: S = one_hot(y) * w, C = w), the
-    count histogram is derived as the sum over class histograms instead of
-    contracting an extra column — one fewer MXU row per node, exact.
-
-    Wide frontiers on large data route to the compacted (sparsity-
-    exploiting) histogram; the static gate keeps the dense form where its
-    one-hot is already narrow."""
-    hist = (
-        _level_histogram_compact
-        if _use_compact(xb.shape[0], n_nodes)
-        else _level_histogram
-    )
-    if not count_from_stats:
-        return hist(local, xb, SC, n_nodes, n_bins, precision)
-    # count_from_stats == classification: stats are one_hot(y) x integer
-    # bootstrap/fold counts (< 128 by _bootstrap_counts' cap) — the s8 MXU
-    # path applies
-    H = hist(local, xb, SC[:, :k], n_nodes, n_bins, precision,
-             integer_stats=True)
-    return jnp.concatenate([H, jnp.sum(H, axis=-1, keepdims=True)], axis=-1)
+    """Single-group level histogram [m, d, nb, k+1]. Wide frontiers on
+    large data may route to the compacted (sparsity-exploiting) histogram;
+    the static gate keeps the dense form where its one-hot is already
+    narrow."""
+    if _use_compact(xb.shape[0], n_nodes):
+        if not count_from_stats:
+            return _level_histogram_compact(local, xb, SC, n_nodes, n_bins, precision)
+        H = _level_histogram_compact(local, xb, SC[:, :k], n_nodes, n_bins,
+                                     precision, integer_stats=True)
+        return jnp.concatenate([H, jnp.sum(H, axis=-1, keepdims=True)], axis=-1)
+    return _hist_with_count_multi(
+        local, (xb,), SC, n_nodes, (n_bins,), precision, k, count_from_stats
+    )[0]
 
 
 def build_tree(
@@ -530,6 +583,11 @@ def build_tree(
     }
 
 
+#: features with at most this many bin codes qualify for the deep builder's
+#: narrow coarse-histogram group (one-hot/binary columns: 2 codes)
+COARSE_BINS = int(os.environ.get("CS230_COARSE_BINS", "4"))
+
+
 def build_tree_deep(
     xb,
     S,
@@ -543,6 +601,7 @@ def build_tree_deep(
     key=None,
     precision=jax.lax.Precision.HIGHEST,
     count_from_stats: bool = False,
+    groups: Optional[Dict[str, jnp.ndarray]] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Deep tree via frontier-compacted level-wise growth (batched best-first).
 
@@ -565,6 +624,17 @@ def build_tree_deep(
     - per-level cost is O(n * width * kk * d * n_bins) MACs regardless of
       depth, all on the MXU; total leaf budget ~ width * levels (~12k at the
       defaults), the regime sklearn's grow-to-purity needs.
+
+    ``groups`` (optional): feature-grouped histograms. Low-cardinality
+    features (one-hot/binary columns — 44 of Covertype's 54) waste nearly
+    the whole n_bins axis of the histogram, and per-level cost is linear in
+    the bin total; splitting features into a continuous group (full n_bins)
+    and a coarse group (COARSE_BINS bins) cuts histogram MACs by
+    sum(nb_f)/d*n_bins — ~3x on Covertype — with the identical split
+    candidate set (quantile_bins dedup makes coarse codes compact). The
+    dict carries {"xb_cont" [n, dc], "xb_coarse" [n, db], "fid_cont" [dc],
+    "fid_coarse" [db]}; split records stay in GLOBAL feature ids, so
+    routing, prediction, and artifacts are unchanged.
 
     Shapes are static: the frontier width at level l is min(2^l, width)
     (early levels don't pay the full budget), the arena is a fixed
@@ -602,12 +672,61 @@ def build_tree_deep(
     # from the [A+1] arena tables (profiled ~3x slower).
     lvl_ids, lvl_feat, lvl_bin, lvl_left = [], [], [], []
 
+    # feature groups: (xb columns, global feature ids or None, bin count)
+    if groups is not None:
+        gspec = (
+            (groups["xb_cont"], groups["fid_cont"], n_bins),
+            (groups["xb_coarse"], groups["fid_coarse"], COARSE_BINS),
+        )
+    else:
+        gspec = ((xb, None, n_bins),)
+
+    def hist_groups(local, m):
+        if len(gspec) == 1:
+            # single group: keep the compact-histogram opt-in gate reachable
+            # (_use_compact routes wide frontiers when CS230_HIST_COMPACT=1)
+            return (_hist_with_count(
+                local, gspec[0][0], SC, m, gspec[0][2], precision, k,
+                count_from_stats,
+            ),)
+        # ONE row scan for all groups: the dominant [row_chunk, m*kk]
+        # one-hot ⊗ stats operand is built once and contracted against each
+        # group's bin one-hot (see _level_histogram_multi)
+        return _hist_with_count_multi(
+            local,
+            tuple(xg for xg, _, _ in gspec),
+            SC, m,
+            tuple(nbg for _, _, nbg in gspec),
+            precision, k, count_from_stats,
+        )
+
+    def best_from_hists(Hs, node_ids):
+        """Per-node best (gain, GLOBAL feature, bin) across groups; ties
+        keep the earlier group (continuous first)."""
+        allowed = _feature_subset_allowed(node_ids, key, max_features, d)
+        best = None
+        for Hg, (_, fidg, nbg) in zip(Hs, gspec):
+            g = _split_gain(Hg, k, nbg, min_samples_leaf)
+            if allowed is not None:
+                ag = allowed if fidg is None else jnp.take(allowed, fidg, axis=1)
+                g = jnp.where(ag[:, :, None], g, -jnp.inf)
+            bg, bfl, bbl = _pick_best(g, nbg)
+            bfg = bfl if fidg is None else jnp.take(fidg, bfl).astype(jnp.int32)
+            if best is None:
+                best = (bg, bfg, bbl)
+            else:
+                new = bg > best[0]
+                best = (
+                    jnp.maximum(bg, best[0]),
+                    jnp.where(new, bfg, best[1]),
+                    jnp.where(new, bbl, best[2]),
+                )
+        return best
+
     # root: full histogram + its best split
     frontier = jnp.zeros((1,), jnp.int32)
-    H = _hist_with_count(node, xb, SC, 1, n_bins, precision, k, count_from_stats)
-    g = _split_gain(H, k, n_bins, min_samples_leaf)
-    g = _node_feature_mask(g, frontier, key, max_features, d)
-    gain, bf, bb = _pick_best(g, n_bins)
+    H = hist_groups(node, 1)
+    gain, bf, bb = best_from_hists(H, frontier)
 
     for level in range(levels):
         W_l = frontier.shape[0]
@@ -663,16 +782,15 @@ def build_tree_deep(
         # children's histograms: left by matmul over parent slots, right by
         # subtraction (exact for integer stats; float tails are gain-clamped)
         local_left = jnp.where(in_split & go_left, slot, W_l)
-        H_L = _hist_with_count(local_left, xb, SC, W_l, n_bins, precision,
-                               k, count_from_stats)
-        H_R = H - H_L
-        cand_H = jnp.concatenate([H_L, H_R], axis=0)  # [2*W_l, d, bins, k+1]
+        H_L = hist_groups(local_left, W_l)
+        cand_H = tuple(
+            jnp.concatenate([hl, h - hl], axis=0)  # [2*W_l, d_g, nb_g, k+1]
+            for h, hl in zip(H, H_L)
+        )
         cand_id = jnp.concatenate(
             [jnp.where(do_split, left_id, -1), jnp.where(do_split, left_id + 1, -1)]
         )
-        cg = _split_gain(cand_H, k, n_bins, min_samples_leaf)
-        cg = _node_feature_mask(cg, cand_id, key, max_features, d)
-        cgain, cbf, cbb = _pick_best(cg, n_bins)
+        cgain, cbf, cbb = best_from_hists(cand_H, cand_id)
         cgain = jnp.where(cand_id >= 0, cgain, -jnp.inf)
 
         W_next = min(2 * W_l, width_at(level + 1))
@@ -682,7 +800,7 @@ def build_tree_deep(
         gain = vals
         bf = cbf[sel]
         bb = cbb[sel]
-        H = cand_H[sel]
+        H = tuple(h[sel] for h in cand_H)
 
     leaf_S = jax.ops.segment_sum(S, node, num_segments=A + 1)
     leaf_C = jax.ops.segment_sum(C, node, num_segments=A + 1)

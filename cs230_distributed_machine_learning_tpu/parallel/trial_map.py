@@ -68,6 +68,16 @@ def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
     )
 
 
+def _call_with_prepared(fn, prepared, *args):
+    """Invoke a kernel cost hook, passing the prepared-data dict to kernels
+    whose estimators price it (tree kernels: grouped histograms change the
+    true MAC count) while staying compatible with 3-arg estimators."""
+    try:
+        return fn(*args, prepared=prepared)
+    except TypeError:
+        return fn(*args)
+
+
 #: buckets whose total analytical MACs fall below this run on the HOST XLA
 #: CPU backend when the default backend is an accelerator: dispatching an
 #: iris-sized fit to a (possibly tunneled) TPU costs more in round-trip
@@ -241,7 +251,10 @@ def run_trials(
         # so large forests keep bounded dispatches there too.
         chunk_plan = None
         if hasattr(kernel, "chunked_plan"):
-            chunk_plan = kernel.chunked_plan(static, n, d, data.n_classes, plan.n_splits)
+            chunk_plan = _call_with_prepared(
+                kernel.chunked_plan, X_np,
+                static, n, d, data.n_classes, plan.n_splits,
+            )
 
         # Host fast path decision (before any accelerator transfer): a bucket
         # whose entire work is trivial next to one device round trip runs on
@@ -253,8 +266,8 @@ def run_trials(
             and single_device
             and jax.default_backend() != "cpu"
             and hasattr(kernel, "macs_estimate")
-            and kernel.macs_estimate(n, d, static) * max(plan.n_splits, 1)
-            * len(idxs) <= _HOST_EXEC_MACS
+            and _call_with_prepared(kernel.macs_estimate, X_np, n, d, static)
+            * max(plan.n_splits, 1) * len(idxs) <= _HOST_EXEC_MACS
         )
         if host_exec:
             cpu_dev = jax.local_devices(backend="cpu")[0]
@@ -480,7 +493,9 @@ def fit_single(
     # bounded-time dispatches too (same rationale as the chunked trial path)
     chunk_plan = None
     if hasattr(kernel, "chunked_plan") and hasattr(kernel, "fit_chunk"):
-        chunk_plan = kernel.chunked_plan(static, n, d, data.n_classes, 1)
+        chunk_plan = _call_with_prepared(
+            kernel.chunked_plan, X, static, n, d, data.n_classes, 1
+        )
     if chunk_plan:
         n_chunks = int(chunk_plan["n_chunks"])
         ck = fit_key + ("chunked", n_chunks, chunk_plan["trees_per_chunk"])
