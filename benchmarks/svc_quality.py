@@ -1,0 +1,86 @@
+"""Measure the full-Covertype Nyström SVC point (VERDICT r3 #6).
+
+The reference's libsvm workers cannot complete this fit at all (SMO is
+O(n^2..3)); the comparison point is sklearn SVC cross-validated on a 30k
+subsample (measured once at 0.865 — pass --sklearn to re-measure, it
+costs ~hours on this 1-core box). This harness measures OUR side: wall
+time + 5-fold mean CV for the current kernel configuration, so landmark
+/ solver changes can be A/B'd on the real chip.
+
+Usage:
+  python benchmarks/svc_quality.py                 # current defaults
+  CS230_SVM_KMEANS_ITERS=8 python benchmarks/svc_quality.py   # k-means landmarks
+  python benchmarks/svc_quality.py --sklearn       # also re-measure sklearn side
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sklearn", action="store_true",
+                    help="re-measure the sklearn 30k-subsample reference (slow)")
+    ap.add_argument("--trials", type=int, default=1)
+    args = ap.parse_args()
+
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        _synthetic_covertype,
+    )
+    from cs230_distributed_machine_learning_tpu.models.base import TrialData
+    from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+    from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+    from cs230_distributed_machine_learning_tpu.parallel.trial_map import run_trials
+
+    df = _synthetic_covertype()
+    X = df.values[:, :-1].astype(np.float32)
+    y = (df.values[:, -1] - 1).astype(np.int32)
+    data = TrialData(X=X, y=y, n_classes=7)
+    plan = build_split_plan(y, task="classification", n_folds=5)
+    kernel = get_kernel("SVC")
+
+    t0 = time.time()
+    out = run_trials(kernel, data, plan, [{"C": 1.0}] * args.trials)
+    elapsed = time.time() - t0
+    cv = out.trial_metrics[0]["mean_cv_score"]
+
+    from cs230_distributed_machine_learning_tpu.models.svm import (
+        _kmeans_iters,
+        _nystrom_steps,
+    )
+
+    record = {
+        "n": int(len(X)),
+        "cv": float(cv),
+        "time_s": round(elapsed, 1),
+        "kmeans_iters": _kmeans_iters(),
+        "nystrom_steps": _nystrom_steps(),
+        "m": os.environ.get("CS230_SVM_NYSTROM_M", "auto"),
+    }
+
+    if args.sklearn:
+        from sklearn.model_selection import cross_val_score
+        from sklearn.svm import SVC
+
+        rng = np.random.RandomState(0)
+        idx = rng.permutation(len(X))[:30_000]
+        t0 = time.time()
+        record["sklearn_30k_cv"] = float(
+            cross_val_score(SVC(C=1.0), X[idx], y[idx], cv=3).mean()
+        )
+        record["sklearn_30k_time_s"] = round(time.time() - t0, 1)
+
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()

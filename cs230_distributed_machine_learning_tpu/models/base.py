@@ -173,6 +173,14 @@ class ModelKernel(abc.ABC):
     def memory_estimate_mb(self, n: int, d: int, static: Dict[str, Any]) -> float:
         return max(1.0, 4.0 * n * max(d, 1) * 3 / 1e6)
 
+    def trace_salt(self) -> Tuple:
+        """Values read from the environment at TRACE time (solver step
+        counts, landmark knobs, ...) that change the compiled program
+        without appearing in ``static`` — they must key every executable
+        cache, or a knob change silently loads the pre-knob blob. Kernels
+        reading env at trace time must override."""
+        return ()
+
 
 def add_intercept(X, fit_intercept: bool):
     """[X | 1] design matrix when fitting an intercept (shared by the

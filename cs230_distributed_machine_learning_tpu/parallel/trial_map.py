@@ -64,6 +64,7 @@ def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
         n_splits,
         chunk,
         tuple(hyper_names),
+        kernel.trace_salt(),
         os.environ.get("CS230_PALLAS_INTERPRET", ""),
     )
 

@@ -152,7 +152,28 @@ def test_svc_full_covertype_completes():
     rng = np.random.RandomState(0)
     idx = rng.permutation(len(X))[:30_000]
     sk = cross_val_score(SVC(C=1.0), X[idx], y[idx], cv=3).mean()
-    assert ours > sk - 0.08, (ours, sk)
+    # r4: the 1200-step Nyström solve measures 0.926 vs sklearn's 0.865 —
+    # the full-data fit must now BEAT the subsample reference, not trail it
+    assert ours > sk - 0.01, (ours, sk)
+
+
+def test_trace_salt_keys_solver_knobs(monkeypatch):
+    """Env knobs read at TRACE time must flow into the executable cache
+    key — without this, flipping CS230_SVM_NYSTROM_STEPS between runs
+    silently reloads the pre-knob AOT blob (the bug that masked the r4
+    convergence fix on its first measurement)."""
+    from cs230_distributed_machine_learning_tpu.parallel.trial_map import _aot_key
+
+    kernel = get_kernel("SVC")
+    monkeypatch.setenv("CS230_SVM_NYSTROM_STEPS", "300")
+    salt_a = kernel.trace_salt()
+    monkeypatch.setenv("CS230_SVM_NYSTROM_STEPS", "1200")
+    salt_b = kernel.trace_salt()
+    assert salt_a != salt_b
+
+    X = jnp.zeros((8, 2), jnp.float32)
+    key = _aot_key(kernel, {}, X, 2, 1, 1, [])
+    assert kernel.trace_salt() in key
 
 
 def test_nystrom_landmarks_scale_with_n(monkeypatch):
