@@ -18,14 +18,20 @@ training-loop shape XLA pipelines best on TPU.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .base import ModelKernel
 
 _EPOCH_CAP = 100
+
+
+def _interpret_mode() -> bool:
+    return os.environ.get("CS230_PALLAS_INTERPRET", "") == "1"
 
 
 def _act(name: str):
@@ -210,6 +216,201 @@ class _MLPBase(ModelKernel):
             step, (params, m0, v0, jnp.asarray(0.0)), batches
         )
         return params
+
+
+    # ---- fused Pallas batched path (ops/pallas_mlp.py) -------------------
+    #
+    # On TPU, adam/constant-lr buckets at real-data scale bypass the generic
+    # vmap engine: the whole epoch's minibatch loop runs as ONE Pallas
+    # kernel with (params, m, v) resident in VMEM and k (trial x split)
+    # lanes packed per grid step. The generic path streams ~20 B of Adam
+    # state per param per STEP through HBM — the measured 7.3%-MFU floor at
+    # MNIST scale (VERDICT r3 #4); the fused kernel pays that per EPOCH.
+
+    batched_trial_multiple = 1
+    batched_chunk_cap = 64
+
+    def batched_applicable(self, static: Dict[str, Any], n: int, d: int) -> bool:
+        if static.get("solver", "adam") != "adam":
+            return False
+        if static.get("learning_rate", "constant") != "constant":
+            return False
+        if not static.get("shuffle", True) or static.get("early_stopping"):
+            return False
+        if len(static["_hls"]) > 3:
+            return False
+        if static["_bs"] % 8:  # TPU sublane rule for the batch blocks
+            return False
+        if _interpret_mode():
+            return True
+        return jax.default_backend() == "tpu" and n >= 4096
+
+    def build_batched_fn(self, static, n, d, n_classes, n_splits, chunk):
+        """fn(X, y, TW, EW, hyper) -> {"score": [chunk, n_splits]} (+"mse"
+        for regressors) — fit via the fused Pallas epoch kernel, eval in
+        XLA. Same contract as the engine's vmapped executable."""
+        if not self.batched_applicable(static, n, d):
+            return None
+
+        from ..ops.pallas_mlp import build_epoch_fn, pick_k
+
+        interpret = _interpret_mode()
+        classification = self.task == "classification"
+        c = self._out_dim(static)
+        dims = self._dims(d, static)
+        act = static.get("activation", "relu")
+        bs = int(static["_bs"])
+        epochs = int(static["_epochs"])
+        n_batches = max(1, n // bs)
+        R = n_batches * bs
+        S = int(n_splits)
+        L0 = chunk * S
+        k = pick_k(dims, bs)
+        Lk = -(-L0 // k) * k
+        seed = int(static["_seed"])
+        b1 = float(static.get("beta_1", 0.9))
+        b2 = float(static.get("beta_2", 0.999))
+        eps = float(static.get("epsilon", 1e-8))
+        # the kernel hardcodes sklearn's Adam constants; non-default values
+        # must take the generic path, which honors them
+        if (b1, b2, eps) != (0.9, 0.999, 1e-8):
+            return None
+
+        # lane = trial * S + split; padded lanes replay lane 0 (discarded)
+        ls_np = np.concatenate(
+            [np.arange(L0, dtype=np.int32) % S,
+             np.zeros(Lk - L0, dtype=np.int32)]
+        )
+        lane_split = jnp.asarray(ls_np)
+        epoch_fn = build_epoch_fn(
+            dims, act, bs, n_batches, Lk, k, classification,
+            interpret=interpret,
+        )
+
+        def _lane_vec(h):  # [chunk] hyper -> [Lk, 1] per-lane column
+            v = jnp.repeat(h.astype(jnp.float32), S)
+            v = jnp.concatenate([v, jnp.broadcast_to(v[:1], (Lk - L0,))])
+            return v[:, None]
+
+        rc = 256  # eval row chunk: [Lk, rc, max_h] activations stay <200 MB
+        n_pad = -(-n // rc) * rc
+        # matmul operand dtype: bf16 on the MXU; the CPU interpreter (test
+        # coverage) lacks the mixed bf16->f32 dot
+        mdt = jnp.float32 if interpret else jnp.bfloat16
+
+        def fn(X, y, TW, EW, hyper):
+            Xb = X.astype(mdt)
+            if classification:
+                Y = jax.nn.one_hot(y, c, dtype=jnp.bfloat16)
+            else:
+                Y = y.astype(jnp.float32)[:, None]
+            TWf = TW.astype(jnp.float32)
+            lr = _lane_vec(hyper["learning_rate_init"])
+            alpha = _lane_vec(hyper["alpha"])
+
+            key = jax.random.PRNGKey(seed)
+            key, init_key = jax.random.split(key)
+            params = self._init(init_key, dims)
+            state = []
+            for layer in params:
+                # biases ride as [Lk, 8, out] row-identical slabs (see
+                # ops/pallas_mlp.py kernel docstring for the layout rule)
+                for leaf in (layer["W"], jnp.tile(layer["b"][None, :], (8, 1))):
+                    state.append(jnp.tile(leaf[None], (Lk,) + (1,) * leaf.ndim))
+                    state.append(jnp.zeros((Lk,) + leaf.shape, jnp.float32))
+                    state.append(jnp.zeros((Lk,) + leaf.shape, jnp.float32))
+            # reorder to the kernel's per-layer (pW, pB, mW, mB, vW, vB)
+            flat = []
+            for li in range(len(params)):
+                pW, mW, vW, pB, mB, vB = state[6 * li : 6 * (li + 1)]
+                flat.extend([pW, pB, mW, mB, vW, vB])
+            state = flat
+
+            ekeys = jax.random.split(key, epochs)
+            t0s = jnp.arange(epochs, dtype=jnp.int32) * n_batches
+
+            def body(st, xs):
+                key_e, t0 = xs
+                perm = jax.random.permutation(key_e, n)[:R]
+                Wl = TWf[:, perm].T[:, lane_split]  # [R, Lk], lane-minor
+                st = epoch_fn(
+                    Xb[perm], Y[perm], Wl, lr, alpha,
+                    t0.reshape(1, 1), st,
+                )
+                return st, None
+
+            state, _ = jax.lax.scan(body, state, (ekeys, t0s))
+
+            # ---- eval (XLA): weighted score per lane over row chunks ----
+            pWs = [state[6 * li] for li in range(len(params))]
+            pBs = [state[6 * li + 1][:, 0:1, :] for li in range(len(params))]
+            act_f = _act(act)
+            Xe = jnp.pad(Xb, ((0, n_pad - n), (0, 0)))
+            EWp = jnp.pad(EW.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
+            if classification:
+                ye = jnp.pad(y.astype(jnp.int32), (0, n_pad - n))
+            else:
+                ye = jnp.pad(y.astype(jnp.float32), (0, n_pad - n))
+
+            def forward_chunk(start):
+                h = jax.lax.dynamic_slice(Xe, (start, 0), (rc, d))
+                out = jnp.einsum(
+                    "rd,ldh->lrh", h, pWs[0].astype(mdt),
+                    preferred_element_type=jnp.float32,
+                ) + pBs[0]
+                for li in range(1, len(params)):
+                    out = jnp.einsum(
+                        "lrh,lhk->lrk",
+                        act_f(out).astype(mdt),
+                        pWs[li].astype(mdt),
+                        preferred_element_type=jnp.float32,
+                    ) + pBs[li]
+                ewc = jax.lax.dynamic_slice(
+                    EWp, (0, start), (S, rc)
+                )[lane_split]  # [Lk, rc]
+                return out, ewc
+
+            if classification:
+                def ebody(acc, start):
+                    out, ewc = forward_chunk(start)
+                    pred = jnp.argmax(out, axis=-1)
+                    yc = jax.lax.dynamic_slice(ye, (start,), (rc,))
+                    hit = (pred == yc[None, :]).astype(jnp.float32)
+                    return acc + jnp.sum(hit * ewc, axis=1), None
+
+                acc, _ = jax.lax.scan(
+                    ebody, jnp.zeros((Lk,), jnp.float32),
+                    jnp.arange(0, n_pad, rc),
+                )
+                den = jnp.sum(EWp, axis=1)[lane_split]
+                score = acc / jnp.maximum(den, 1e-12)
+                return {"score": score[:L0].reshape(chunk, S)}
+
+            def ebody(carry, start):
+                sw, swy, swyy, ssr = carry
+                out, ewc = forward_chunk(start)
+                pred = out[:, :, 0]
+                yc = jax.lax.dynamic_slice(ye, (start,), (rc,))[None, :]
+                sw = sw + jnp.sum(ewc, axis=1)
+                swy = swy + jnp.sum(ewc * yc, axis=1)
+                swyy = swyy + jnp.sum(ewc * yc * yc, axis=1)
+                ssr = ssr + jnp.sum(ewc * (yc - pred) ** 2, axis=1)
+                return (sw, swy, swyy, ssr), None
+
+            z = jnp.zeros((Lk,), jnp.float32)
+            (sw, swy, swyy, ssr), _ = jax.lax.scan(
+                ebody, (z, z, z, z), jnp.arange(0, n_pad, rc)
+            )
+            swc = jnp.maximum(sw, 1e-12)
+            ss_tot = jnp.maximum(swyy - swy * swy / swc, 1e-12)
+            r2 = 1.0 - ssr / ss_tot
+            mse = ssr / swc
+            return {
+                "score": r2[:L0].reshape(chunk, S),
+                "mse": mse[:L0].reshape(chunk, S),
+            }
+
+        return fn
 
 
 class MLPClassifierKernel(_MLPBase):

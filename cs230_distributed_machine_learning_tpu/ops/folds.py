@@ -34,6 +34,12 @@ class SplitPlan:
     train_w: np.ndarray  # [K+1, n] float32 {0,1}
     eval_w: np.ndarray   # [K+1, n] float32 {0,1}
     n_folds: int
+    #: content identity: (task, n, n_folds, test_size, random_state).
+    #: Plans are deterministic in these, so equal signatures mean equal
+    #: masks — the trial engine keys its device-staging cache on this
+    #: (re-uploading fold tensors per job costs real seconds on a
+    #: tunneled link). None (e.g. hand-built test plans) disables caching.
+    signature: tuple | None = None
 
     @property
     def n_splits(self) -> int:
@@ -85,6 +91,7 @@ def build_split_plan(
         train_w=np.stack(rows_train).astype(np.float32),
         eval_w=np.stack(rows_eval).astype(np.float32),
         n_folds=n_folds or 0,
+        signature=(task, n, n_folds or 0, float(test_size), random_state),
     )
 
 

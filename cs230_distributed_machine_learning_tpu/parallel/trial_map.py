@@ -17,6 +17,7 @@ scalar results — replacing the reference's per-trial Kafka round trips.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import os
 import time
@@ -32,6 +33,7 @@ from ..models.base import ModelKernel, TrialData
 from ..ops.folds import SplitPlan
 from ..utils.aot_cache import aot_jit
 from .distributed import fetch as _fetch
+from .distributed import prefetch_async
 from .mesh import pad_to_multiple
 
 _compiled_cache: Dict[Any, Any] = {}
@@ -67,6 +69,65 @@ def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
         kernel.trace_salt(),
         os.environ.get("CS230_PALLAS_INTERPRET", ""),
     )
+
+
+def _prepared_data(kernel, data, static_key, static):
+    """Bucket-level prepare_data (tree binning etc.), cached ON the
+    TrialData object so repeat jobs over a coordinator-cached dataset skip
+    it. The prepare step round-trips the device (bin_data computes on
+    device, ~0.11 s fetch on a tunneled link) — measured as a third of a
+    tiny job's whole steady cost. Keying by (kernel, static bucket key)
+    is exact: prepare_data only reads shape-determining statics, which is
+    precisely what the bucket key hashes. Lifetime rides the dataset
+    cache: evicting the TrialData drops the prepared forms with it."""
+    cache = getattr(data, "_prepared_cache", None)
+    if cache is None:
+        cache = {}
+        try:
+            object.__setattr__(data, "_prepared_cache", cache)
+        except Exception:  # exotic TrialData subclass: just don't cache
+            return kernel.prepare_data(np.asarray(data.X), static)
+    key = (kernel.name, static_key)
+    if key not in cache:
+        cache[key] = kernel.prepare_data(np.asarray(data.X), static)
+    return cache[key]
+
+
+#: distinct staged entries kept per dataset — each can be dataset-sized in
+#: HBM, so a static-param sweep over many buckets must not pin one copy
+#: per bucket forever (LRU; fold tensors and X share the budget)
+_STAGED_CACHE_MAX = 6
+
+
+def _staged_device(data, key, make):
+    """Device copies of job-invariant tensors (the dataset, fold masks),
+    cached ON the TrialData object like ``_prepared_data``. On a tunneled
+    device, host->device bandwidth is the scarcest resource of all —
+    measured ~9 MB/s, so re-staging a 188 MB MNIST matrix costs ~20 s PER
+    BUCKET while the whole fused fit runs in ~2 s. Keyed by placement +
+    content signature; lifetime rides the dataset cache entry, bounded by
+    an LRU so bucket sweeps cannot pin unbounded HBM."""
+    cache = getattr(data, "_device_cache", None)
+    if cache is None:
+        cache = collections.OrderedDict()
+        try:
+            object.__setattr__(data, "_device_cache", cache)
+        except Exception:
+            return make()
+    if key in cache:
+        cache.move_to_end(key)
+    else:
+        cache[key] = make()
+        while len(cache) > _STAGED_CACHE_MAX:
+            cache.popitem(last=False)
+    return cache[key]
+
+
+# overlapped device->host transfers (measured ~100 ms serial round trip
+# per converted leaf on the tunneled link — the whole cost floor of tiny
+# jobs, BASELINE configs 1/4): start every pending copy before the first
+# blocking conversion
+_prefetch_async = prefetch_async
 
 
 def _call_with_prepared(fn, prepared, *args):
@@ -185,13 +246,33 @@ def run_trials(
 
     def _dev_args():
         if not _dev_cache:
-            _dev_cache.append(
-                (jnp.asarray(data.y), jnp.asarray(plan.train_w), jnp.asarray(plan.eval_w))
-            )
+            def make():
+                return (
+                    jnp.asarray(data.y),
+                    jnp.asarray(plan.train_w),
+                    jnp.asarray(plan.eval_w),
+                )
+
+            if plan.signature is not None:
+                _dev_cache.append(
+                    _staged_device(data, ("folds", plan.signature), make)
+                )
+            else:
+                _dev_cache.append(make())
         return _dev_cache[0]
 
     def _drain():
         nonlocal run_time, t_first_dispatch
+        # overlap every pending device->host transfer before the first
+        # blocking conversion (serial ~100 ms round trips otherwise)
+        for bi, bs, _ in pending_best:
+            _prefetch_async((bi, bs))
+        for out, _ in pending:
+            if isinstance(out, list):
+                for og, _size in out:
+                    _prefetch_async(og)
+            else:
+                _prefetch_async(out)
         for bi, bs, batch_idx in pending_best:
             pos, score = int(bi), float(bs)
             if pos < len(batch_idx) and np.isfinite(score):
@@ -201,10 +282,7 @@ def run_trials(
             # fetch (not np.asarray): under a multi-process mesh the trial-
             # sharded output spans hosts and is assembled collectively
             if isinstance(out, list):  # split-group dispatches: concat folds
-                fetched = [
-                    (_fetch(jax.block_until_ready(og)), size)
-                    for og, size in out
-                ]
+                fetched = [(_fetch(og), size) for og, size in out]
                 out = {
                     k: np.concatenate(
                         [og[k][:, :size] for og, size in fetched], axis=1
@@ -212,7 +290,7 @@ def run_trials(
                     for k in fetched[0][0]
                 }
             else:
-                out = _fetch(jax.block_until_ready(out))
+                out = _fetch(out)
             for j, gi in enumerate(batch_idx):
                 results[gi] = _postprocess(out, j, plan, kernel.task, scoring)
         pending.clear()
@@ -232,9 +310,10 @@ def run_trials(
             static["_scoring"] = scoring
 
         # bucket-level data prep (e.g. feature binning for trees): computed
-        # once, shared by every trial and split in the bucket
+        # once, shared by every trial and split in the bucket — and cached
+        # across jobs on the TrialData object
         if hasattr(kernel, "prepare_data"):
-            X_np = kernel.prepare_data(np.asarray(data.X), static)
+            X_np = _prepared_data(kernel, data, static_key, static)
         else:
             X_np = np.asarray(data.X, np.float32)
 
@@ -270,11 +349,27 @@ def run_trials(
             and _call_with_prepared(kernel.macs_estimate, X_np, n, d, static)
             * max(plan.n_splits, 1) * len(idxs) <= _HOST_EXEC_MACS
         )
+        # without prepare_data every bucket stages the same [n, d] matrix —
+        # key by placement alone so an 8-bucket MLP grid uploads X once,
+        # not 8 times (~20 s each for MNIST over the tunnel)
+        x_key = (
+            ("X", kernel.name, static_key)
+            if hasattr(kernel, "prepare_data") else ("X",)
+        )
         if host_exec:
             cpu_dev = jax.local_devices(backend="cpu")[0]
             put = lambda a: jax.device_put(np.asarray(a), cpu_dev)  # noqa: E731
-            X = jax.tree_util.tree_map(put, X_np)
+            X = _staged_device(
+                data, x_key + ("host",),
+                lambda: jax.tree_util.tree_map(put, X_np),
+            )
+        elif single_device:
+            X = _staged_device(
+                data, x_key + ("dev",),
+                lambda: jax.tree_util.tree_map(jnp.asarray, X_np),
+            )
         else:
+            # mesh path: leave staging to jit's sharding machinery
             X = jax.tree_util.tree_map(jnp.asarray, X_np)
         if chunk_plan:
             # flush queued generic dispatches first: the chunked bucket runs
@@ -475,7 +570,7 @@ def fit_single(
 
     if hasattr(kernel, "prepare_data"):
         X = jax.tree_util.tree_map(
-            jnp.asarray, kernel.prepare_data(np.asarray(data.X), static)
+            jnp.asarray, _prepared_data(kernel, data, static_key, static)
         )
     else:
         X = jnp.asarray(data.X, jnp.float32)
@@ -516,6 +611,8 @@ def fit_single(
             carry, part = f_chunk(X, y, w, hyper_arg, jnp.int32(ci), carry)
             parts.append(part)  # device arrays: dispatches pipeline
         n_units = int(static.get("n_estimators", 100))
+        for p in parts:
+            _prefetch_async(p)
         parts = [jax.tree_util.tree_map(np.asarray, p) for p in parts]
         trees = jax.tree_util.tree_map(
             lambda *xs: np.concatenate(xs, axis=0)[:n_units], *parts
@@ -845,10 +942,9 @@ def _run_chunked(
                 device_best is None or score > device_best[1]
             ):
                 device_best = (batch_idx[pos], score)
-        group_outs = [
-            (_fetch(jax.block_until_ready(og)), size)
-            for og, size in group_outs
-        ]
+        for og, _size in group_outs:
+            _prefetch_async(og)
+        group_outs = [(_fetch(og), size) for og, size in group_outs]
         out = {
             k: np.concatenate([og[k][:, :size] for og, size in group_outs], axis=1)
             for k in group_outs[0][0]

@@ -1,0 +1,141 @@
+"""Tests for the fused Pallas MLP training path (ops/pallas_mlp.py).
+
+Runs on CPU: the epoch kernel in interpreter mode via
+CS230_PALLAS_INTERPRET=1, checked against the generic vmapped engine path
+(itself parity-tested against sklearn in test_mlp.py). The fused path is
+the VERDICT r3 #4 deliverable — VMEM-resident Adam state instead of the
+per-step HBM streaming that floored MFU at 7.3%.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+from cs230_distributed_machine_learning_tpu.parallel.trial_map import _make_batched
+
+
+def _scores(kernel_name, X, y, params_list, n_classes, task, n_folds=2,
+            monkeypatch=None):
+    kernel = get_kernel(kernel_name)
+    static_key, _ = kernel.canonicalize(params_list[0])
+    static = kernel.resolve_static(
+        kernel.static_from_key(static_key), len(X), X.shape[1], n_classes
+    )
+    static["_n_classes"] = n_classes
+    plan = build_split_plan(y, task=task, n_folds=n_folds)
+    TW, EW = jnp.asarray(plan.train_w), jnp.asarray(plan.eval_w)
+    hypers = [kernel.canonicalize(p)[1] for p in params_list]
+    hj = {
+        k: jnp.asarray([h[k] for h in hypers], jnp.float32)
+        for k in hypers[0]
+    }
+    gen = _make_batched(kernel, static, True)(
+        jnp.asarray(X), jnp.asarray(y), TW, EW, hj
+    )
+    fn = kernel.build_batched_fn(
+        static, len(X), X.shape[1], n_classes, plan.n_splits, len(params_list)
+    )
+    assert fn is not None, "fused MLP path must engage under interpret mode"
+    fus = fn(jnp.asarray(X), jnp.asarray(y), TW, EW, hj)
+    return gen, fus
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setenv("CS230_PALLAS_INTERPRET", "1")
+
+
+def test_classifier_matches_generic():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=512, n_features=20, n_informative=10, n_classes=3,
+        random_state=0,
+    )
+    gen, fus = _scores(
+        "MLPClassifier", X.astype(np.float32), y.astype(np.int32),
+        [
+            {"hidden_layer_sizes": (32,), "max_iter": 30, "batch_size": 64,
+             "random_state": 0, "alpha": 1e-4, "learning_rate_init": 1e-3},
+            {"hidden_layer_sizes": (32,), "max_iter": 30, "batch_size": 64,
+             "random_state": 0, "alpha": 1e-3, "learning_rate_init": 3e-3},
+        ],
+        3, "classification",
+    )
+    # identical math up to f32-vs-bf16 moment storage: within a few samples
+    assert np.max(np.abs(np.asarray(gen["score"]) - np.asarray(fus["score"]))) < 0.02
+
+
+def test_two_hidden_layers_tanh():
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=400, n_features=16, n_informative=8, n_classes=3,
+        random_state=1,
+    )
+    gen, fus = _scores(
+        "MLPClassifier", X.astype(np.float32), y.astype(np.int32),
+        [{"hidden_layer_sizes": (32, 16), "max_iter": 20, "batch_size": 48,
+          "random_state": 0, "activation": "tanh"}],
+        3, "classification",
+    )
+    assert np.max(np.abs(np.asarray(gen["score"]) - np.asarray(fus["score"]))) < 0.02
+
+
+def test_regressor_matches_generic():
+    from sklearn.datasets import make_regression
+
+    X, y = make_regression(n_samples=400, n_features=16, noise=2.0,
+                           random_state=1)
+    y = (y / np.abs(y).max()).astype(np.float32)
+    gen, fus = _scores(
+        "MLPRegressor", X.astype(np.float32), y,
+        [{"hidden_layer_sizes": (32,), "max_iter": 20, "batch_size": 48,
+          "random_state": 0}],
+        0, "regression",
+    )
+    for key in ("score", "mse"):
+        assert np.max(np.abs(np.asarray(gen[key]) - np.asarray(fus[key]))) < 0.02
+
+
+def test_inapplicable_configs_fall_back():
+    """sgd solver / non-multiple-of-8 batch / adaptive lr must return None
+    (the engine then uses the generic vmapped path)."""
+    kernel = get_kernel("MLPClassifier")
+
+    def static_for(extra):
+        sk, _ = kernel.canonicalize(
+            {"hidden_layer_sizes": (16,), "max_iter": 5, **extra}
+        )
+        st = kernel.resolve_static(kernel.static_from_key(sk), 256, 8, 2)
+        st["_n_classes"] = 2
+        return st
+
+    assert kernel.build_batched_fn(static_for({"solver": "sgd"}), 256, 8, 2, 3, 1) is None
+    assert kernel.build_batched_fn(static_for({"batch_size": 50}), 256, 8, 2, 3, 1) is None
+    assert (
+        kernel.build_batched_fn(
+            static_for({"learning_rate": "adaptive"}), 256, 8, 2, 3, 1
+        )
+        is None
+    )
+    # non-default Adam constants: the kernel hardcodes sklearn's, so these
+    # must fall back to the generic path that honors them
+    assert kernel.build_batched_fn(static_for({"epsilon": 1e-4}), 256, 8, 2, 3, 1) is None
+    assert kernel.build_batched_fn(static_for({"beta_1": 0.8}), 256, 8, 2, 3, 1) is None
+
+
+def test_pick_k_respects_vmem_budget():
+    from cs230_distributed_machine_learning_tpu.ops.pallas_mlp import (
+        pick_k,
+        vmem_lane_bytes,
+    )
+
+    small = pick_k((64, 32, 4), 32)
+    big = pick_k((784, 512, 10), 256)
+    assert small >= big
+    assert big * vmem_lane_bytes((784, 512, 10), 256) <= 48 * 2**20
+    assert pick_k((4096, 4096, 4096, 100), 256) == 1  # never returns 0
