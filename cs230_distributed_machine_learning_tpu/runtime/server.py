@@ -21,6 +21,78 @@ from ..utils.serialization import json_safe
 from .coordinator import Coordinator
 
 
+#: Self-contained observability page (no external assets — fleets run
+#: without egress). Tables over the JSON endpoints, 2 s auto-refresh.
+_DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tpuml coordinator</title>
+<style>
+ body{font:14px/1.45 system-ui,sans-serif;margin:24px;color:#1a1a1a;background:#fafafa}
+ h1{font-size:18px;margin:0 0 4px} h2{font-size:15px;margin:24px 0 6px}
+ table{border-collapse:collapse;width:100%;background:#fff}
+ th,td{border:1px solid #ddd;padding:4px 8px;text-align:left;font-size:13px}
+ th{background:#f0f0f0} .ok{color:#1a7f37} .bad{color:#b42318}
+ #meta{color:#666;font-size:12px} code{background:#eee;padding:0 3px}
+</style></head><body>
+<h1>tpuml coordinator</h1>
+<div id="meta">health: <span id="health">…</span> · refreshed <span id="ts">never</span>
+ · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code></div>
+<h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
+<th>status</th><th>done</th><th>failed</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
+<h2>Workers</h2><table id="workers"><thead></thead><tbody></tbody></table>
+<h2>Queues</h2><table id="queues"><thead></thead><tbody></tbody></table>
+<h2>Supervised agents</h2><table id="sup"><thead></thead><tbody></tbody></table>
+<script>
+const get = u => fetch(u).then(r => r.ok ? r.json() : null).catch(() => null);
+const esc = s => String(s ?? "").replace(/[&<>]/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+// cell renderer: arrays (e.g. a worker's queued-subtask list) collapse to
+// a count + sample, never one column per index
+const cell = v => Array.isArray(v)
+  ? `${v.length} queued${v.length ? ": " + v.slice(0, 3).join(", ") + (v.length > 3 ? ", …" : "") : ""}`
+  : (typeof v === "object" && v ? JSON.stringify(v) : v);
+function kvTable(el, obj){
+  const rows = Object.entries(obj || {});
+  if (!rows.length){ el.tBodies[0].innerHTML = "<tr><td>none</td></tr>"; el.tHead.innerHTML=""; return; }
+  const plain = rows.every(([,v]) => typeof v !== "object" || !v || Array.isArray(v));
+  const cols = plain ? null
+    : [...new Set(rows.flatMap(([,v]) => Object.keys(v)))];
+  el.tHead.innerHTML = plain
+    ? "<tr><th>id</th><th>value</th></tr>"
+    : "<tr><th>id</th>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  el.tBodies[0].innerHTML = rows.map(([k, v]) =>
+    `<tr><td>${esc(k)}</td>` + (plain
+      ? `<td>${esc(cell(v))}</td>`
+      : cols.map(c => `<td>${esc(cell(v[c]))}</td>`).join("")) + "</tr>").join("");
+}
+function listTable(el, arr){
+  if (!arr || !arr.length){ el.tBodies[0].innerHTML = "<tr><td>none</td></tr>"; el.tHead.innerHTML=""; return; }
+  const cols = Object.keys(arr[0]);
+  el.tHead.innerHTML = "<tr>" + cols.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+  el.tBodies[0].innerHTML = arr.map(r =>
+    "<tr>" + cols.map(c => `<td>${esc(JSON.stringify(r[c]))}</td>`).join("") + "</tr>").join("");
+}
+async function tick(){
+  const [h, jobs, workers, queues, sup] = await Promise.all(
+    ["/health", "/jobs", "/workers", "/queues", "/supervisor"].map(get));
+  const he = document.getElementById("health");
+  he.textContent = h ? h.status : "unreachable";
+  he.className = h && h.status === "ok" ? "ok" : "bad";
+  document.getElementById("jobs").tBodies[0].innerHTML =
+    (Array.isArray(jobs) ? jobs : []).map(j => `<tr>
+    <td>${esc(j.job_id)}</td><td>${esc(j.model_type)}</td><td>${esc(j.dataset_id)}</td>
+    <td class="${j.status === "completed" ? "ok" : j.status === "failed" ? "bad" : ""}">${esc(j.status)}</td>
+    <td>${esc(j.completed_subtasks)}</td><td>${esc(j.failed_subtasks)}</td>
+    <td>${esc(j.total_subtasks)}</td><td>${esc((j.session_id || "").slice(0, 8))}</td></tr>`).join("")
+    || "<tr><td colspan=8>no jobs yet</td></tr>";
+  kvTable(document.getElementById("workers"), workers);
+  kvTable(document.getElementById("queues"), queues);
+  listTable(document.getElementById("sup"), sup);
+  document.getElementById("ts").textContent = new Date().toLocaleTimeString();
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>
+"""
+
+
 def create_app(coordinator: Optional[Coordinator] = None):
     from werkzeug.exceptions import HTTPException, NotFound
     from werkzeug.routing import Map, Rule
@@ -44,6 +116,11 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/workers", endpoint="workers", methods=["GET"]),
             Rule("/queues", endpoint="queues", methods=["GET"]),
             Rule("/supervisor", endpoint="supervisor", methods=["GET"]),
+            # visual observability (the reference ran kafka-ui for this,
+            # docker-compose.yml:69-84): one self-contained HTML page over
+            # the JSON introspection endpoints + a flat job feed
+            Rule("/jobs", endpoint="jobs", methods=["GET"]),
+            Rule("/dashboard", endpoint="dashboard", methods=["GET"]),
             # worker-agent control plane (reference scheduler.py:95-159)
             Rule("/subscribe", endpoint="subscribe", methods=["POST"]),
             Rule("/unsubscribe/<wid>", endpoint="unsubscribe", methods=["POST"]),
@@ -78,6 +155,8 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /download_model/<session_id>/<job_id>",
                     "GET  /workers",
                     "GET  /queues",
+                    "GET  /jobs",
+                    "GET  /dashboard  (HTML)",
                     "GET  /health",
                 ],
             }
@@ -159,6 +238,12 @@ def create_app(coordinator: Optional[Coordinator] = None):
     def supervisor(request):
         sup = getattr(coord, "agent_supervisor", None)
         return _json(sup.status() if sup is not None else [])
+
+    def jobs(request):
+        return _json(coord.store.jobs_overview())
+
+    def dashboard(request):
+        return Response(_DASHBOARD_HTML, mimetype="text/html")
 
     def _cluster_or_400():
         if coord.cluster is None:

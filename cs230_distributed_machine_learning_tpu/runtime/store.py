@@ -56,6 +56,35 @@ class JobStore:
         with self._lock:
             return list(self._sessions)
 
+    def jobs_overview(self) -> List[Dict[str, Any]]:
+        """Flat per-job summaries across all sessions — the observability
+        feed for the dashboard (the reference exposed queue/topic state
+        only through kafka-ui, docker-compose.yml:69-84; here job state IS
+        the queue state)."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for sid, sess in self._sessions.items():
+                for jid, job in sess["jobs"].items():
+                    payload = job.get("payload") or {}
+                    out.append(
+                        {
+                            "session_id": sid,
+                            "job_id": jid,
+                            "status": job.get("status"),
+                            "model_type": (payload.get("model_details") or {}).get(
+                                "model_type"
+                            ),
+                            "dataset_id": payload.get("dataset_id"),
+                            "total_subtasks": job.get("total_subtasks"),
+                            "completed_subtasks": job.get("completed_subtasks"),
+                            "failed_subtasks": job.get("failed_subtasks"),
+                            "created_at": job.get("created_at"),
+                            "completion_time": job.get("completion_time"),
+                        }
+                    )
+        out.sort(key=lambda j: j.get("created_at") or 0, reverse=True)
+        return out
+
     # ---------------- jobs ----------------
 
     def create_job(
@@ -130,9 +159,16 @@ class JobStore:
             # pop, don't keep: late waiters short-circuit on the status check
             # in wait_job, and pruning here bounds the dict's size
             event = self._done_events.pop((sid, job_id), None)
+            completion_time = job["completion_time"]
         try:
             self._journal(
-                {"op": "finalize_job", "sid": sid, "jid": job_id, "result": json_safe(result)}
+                {
+                    "op": "finalize_job",
+                    "sid": sid,
+                    "jid": job_id,
+                    "result": json_safe(result),
+                    "completion_time": completion_time,
+                }
             )
         finally:
             if event is not None:
@@ -257,5 +293,9 @@ class JobStore:
                             if (e["result"] or {}).get("status") == "failed"
                             else "completed"
                         )
+                        # older journals predate the field: fall back to
+                        # the entry's absence rather than losing the job
+                        if e.get("completion_time") is not None:
+                            job["completion_time"] = e["completion_time"]
                     except KeyError:
                         continue

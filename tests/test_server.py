@@ -132,3 +132,35 @@ def test_preprocess_endpoint(client, tmp_path):
     df = pd.read_csv(body["preprocessed_path"])
     assert list(df.columns)[-1] == "t"
     assert not df["a"].isna().any()
+
+
+def test_dashboard_and_jobs_feed(client):
+    """The kafka-ui analog (reference docker-compose.yml:69-84): a
+    self-contained HTML page plus the /jobs JSON feed it polls."""
+    page = client.get("/dashboard")
+    assert page.status_code == 200
+    assert page.headers["Content-Type"].startswith("text/html")
+    html = page.get_data(as_text=True)
+    for route in ("/jobs", "/workers", "/queues", "/supervisor", "/health"):
+        assert route in html
+
+    assert client.get("/jobs").get_json() == []
+    sid = _session(client)
+    resp = client.post(
+        "/train/" + sid,
+        data=json.dumps(_train_payload(sid)),
+        content_type="application/json",
+    )
+    assert resp.status_code == 200
+    jid = resp.get_json()["job_id"]
+    import time
+
+    for _ in range(200):
+        feed = client.get("/jobs").get_json()
+        if feed and feed[0]["status"] in ("completed", "failed"):
+            break
+        time.sleep(0.1)
+    assert feed[0]["job_id"] == jid
+    assert feed[0]["status"] == "completed"
+    assert feed[0]["model_type"] == "LogisticRegression"
+    assert feed[0]["total_subtasks"] == 1
