@@ -2,7 +2,7 @@ from setuptools import find_packages, setup
 
 setup(
     name="cs230-distributed-machine-learning-tpu",
-    version="0.1.0",
+    version="0.4.0",
     description=(
         "TPU-native distributed ML training and hyperparameter-search framework "
         "(JAX/XLA re-design of the distributed-ml task farm)"
