@@ -195,7 +195,19 @@ class _KNNBase(ModelKernel):
         # matrix fit), so its budget scales up accordingly — the stale
         # small budget would issue ~7x more dispatches than the bounded-
         # device-time target needs.
-        default = 1.6e12 if int(static.get("n_neighbors", 5)) <= _SMALL_K else 2.5e11
+        # the raised budget applies only when the min-extraction path will
+        # actually run: k <= _SMALL_K AND the Pallas top-k kernel is NOT
+        # taking over (same gate the kernel uses — n >= _PALLAS_MIN_N on an
+        # accelerator backend; the Pallas path's throughput the 6.6x
+        # measurement does not cover, so its budget stays conservative)
+        # gate on the PER-FOLD training rows the kernel will actually see
+        # (~(s-1)/s of n under s-fold CV), matching _neighbors' own check
+        train_rows = n if n_splits <= 1 else (n * (n_splits - 1)) // n_splits
+        small_path = (
+            int(static.get("n_neighbors", 5)) <= _SMALL_K
+            and not _use_pallas(train_rows)
+        )
+        default = 1.6e12 if small_path else 2.5e11
         chunk_macs = float(os.environ.get("CS230_KNN_CHUNK_MACS", default))
         macs = float(max(n_splits, 1)) * n * n * max(d, 1)
         n_chunks = int(np.ceil(macs / chunk_macs))

@@ -47,6 +47,20 @@ def weighted_mae(y_true, y_pred, w):
     return jnp.sum(jnp.abs(y_true - y_pred) * w) / jnp.maximum(jnp.sum(w), _EPS)
 
 
+def weighted_explained_variance(y_true, y_pred, w):
+    """sklearn explained_variance_score: 1 - Var(y - p) / Var(y), both
+    variances weighted over kept rows (differs from r2 by tolerating a
+    constant prediction offset)."""
+    w = w.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w), _EPS)
+    err = y_true - y_pred
+    err_mean = jnp.sum(err * w) / wsum
+    var_err = jnp.sum(w * (err - err_mean) ** 2) / wsum
+    ybar = jnp.sum(y_true * w) / wsum
+    var_y = jnp.maximum(jnp.sum(w * (y_true - ybar) ** 2) / wsum, _EPS)
+    return 1.0 - var_err / var_y
+
+
 def weighted_max_error(y_true, y_pred, w):
     err = jnp.abs(y_true - y_pred)
     return jnp.max(jnp.where(w > 0, err, 0.0))
@@ -153,6 +167,7 @@ _REG_SCORERS = {
     "neg_root_mean_squared_error": lambda y, p, w: -jnp.sqrt(weighted_mse(y, p, w)),
     "neg_mean_absolute_error": lambda y, p, w: -weighted_mae(y, p, w),
     "max_error": lambda y, p, w: -weighted_max_error(y, p, w),
+    "explained_variance": weighted_explained_variance,
 }
 
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -87,7 +88,10 @@ def _prepared_data(kernel, data, static_key, static):
             object.__setattr__(data, "_prepared_cache", cache)
         except Exception:  # exotic TrialData subclass: just don't cache
             return kernel.prepare_data(np.asarray(data.X), static)
-    key = (kernel.name, static_key)
+    # trace_salt folds in the resolve-time env knobs (CS230_TREE_DEEP_N,
+    # CS230_DEEP_W_FORCE, ...) that change prepare_data output without
+    # changing the static bucket key — a knob flip mid-process must miss
+    key = (kernel.name, static_key, kernel.trace_salt())
     if key not in cache:
         cache[key] = kernel.prepare_data(np.asarray(data.X), static)
     return cache[key]
@@ -98,6 +102,11 @@ def _prepared_data(kernel, data, static_key, static):
 #: per bucket forever (LRU; fold tensors and X share the budget)
 _STAGED_CACHE_MAX = 6
 
+#: one lock for every TrialData._device_cache — coordinator job threads
+#: share DatasetCache entries, so inserts/evictions on the same OrderedDict
+#: can interleave; operations under the lock are dict-op cheap
+_STAGED_LOCK = threading.Lock()
+
 
 def _staged_device(data, key, make):
     """Device copies of job-invariant tensors (the dataset, fold masks),
@@ -107,20 +116,29 @@ def _staged_device(data, key, make):
     BUCKET while the whole fused fit runs in ~2 s. Keyed by placement +
     content signature; lifetime rides the dataset cache entry, bounded by
     an LRU so bucket sweeps cannot pin unbounded HBM."""
-    cache = getattr(data, "_device_cache", None)
-    if cache is None:
-        cache = collections.OrderedDict()
-        try:
-            object.__setattr__(data, "_device_cache", cache)
-        except Exception:
-            return make()
-    if key in cache:
-        cache.move_to_end(key)
-    else:
-        cache[key] = make()
-        while len(cache) > _STAGED_CACHE_MAX:
-            cache.popitem(last=False)
-    return cache[key]
+    with _STAGED_LOCK:
+        cache = getattr(data, "_device_cache", None)
+        if cache is None:
+            cache = collections.OrderedDict()
+            try:
+                object.__setattr__(data, "_device_cache", cache)
+            except Exception:
+                cache = None
+        if cache is not None and key in cache:
+            cache.move_to_end(key)
+            return cache[key]
+    # make() outside the lock: staging can be a ~20 s host->device upload,
+    # and a duplicate make() from a concurrent job thread is benign —
+    # unlike a concurrent LRU eviction between insert and a re-read, which
+    # would KeyError. The local `val` is returned directly so eviction of
+    # this key by another thread can never fail THIS call.
+    val = make()
+    if cache is not None:
+        with _STAGED_LOCK:
+            cache[key] = val
+            while len(cache) > _STAGED_CACHE_MAX:
+                cache.popitem(last=False)
+    return val
 
 
 # overlapped device->host transfers (measured ~100 ms serial round trip
@@ -353,7 +371,7 @@ def run_trials(
         # key by placement alone so an 8-bucket MLP grid uploads X once,
         # not 8 times (~20 s each for MNIST over the tunnel)
         x_key = (
-            ("X", kernel.name, static_key)
+            ("X", kernel.name, static_key, kernel.trace_salt())
             if hasattr(kernel, "prepare_data") else ("X",)
         )
         if host_exec:

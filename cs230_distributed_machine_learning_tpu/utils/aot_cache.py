@@ -86,12 +86,24 @@ def _code_fingerprint() -> str:
 
 
 def _generation() -> str:
-    """Cache generation: code fingerprint + jax version. Blobs live in a
-    per-generation subdirectory so superseded generations are prunable."""
+    """Cache generation: code fingerprint + jax version + host CPU
+    fingerprint. Blobs live in a per-generation subdirectory so superseded
+    generations are prunable. The host fingerprint keeps heterogeneous
+    machines sharing a storage root (the deploy/ fleet story) from loading
+    each other's machine-feature-specific binaries (SIGILL hazard flagged
+    by the cpu_aot_loader)."""
     import jax
 
+    host = ""
+    if jax.default_backend() == "cpu":
+        # only CPU-lowered exports embed host machine features; TPU blobs
+        # are device code and MUST stay shared across a heterogeneous-CPU
+        # fleet (the whole payoff of a shared storage root)
+        from .jax_setup import host_fingerprint
+
+        host = host_fingerprint()
     return hashlib.sha256(
-        (_code_fingerprint() + jax.__version__).encode()
+        (_code_fingerprint() + jax.__version__ + host).encode()
     ).hexdigest()[:16]
 
 

@@ -9,9 +9,41 @@ agents joining mid-job.
 
 from __future__ import annotations
 
+import functools
 import os
 
 _done = False
+
+
+@functools.lru_cache(maxsize=1)
+def host_fingerprint() -> str:
+    """Stable fingerprint of THIS host's CPU capabilities.
+
+    XLA:CPU AOT/cache entries embed the compile machine's feature set; a
+    shared cache root across heterogeneous hosts (the deploy/ fleet story —
+    NFS home dirs, identical env vars, different EC2 instance types) would
+    otherwise let host B load host A's binary and SIGILL. Partitioning the
+    cache directory by (machine, cpu-flag set) makes a feature mismatch
+    structurally impossible: hosts with different ISAs never share a
+    subdirectory. The reference has no analog (pure-Python workers); this
+    hazard is specific to compiled-executable caching.
+    """
+    import hashlib
+    import platform as _platform
+
+    parts = [_platform.machine(), _platform.system()]
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags") or line.startswith("Features"):
+                    # flags are a stable, unordered capability set per host
+                    parts.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                    break
+    except OSError:
+        # non-Linux: fall back to the processor string (coarser, still
+        # machine-specific enough to split x86 from arm etc.)
+        parts.append(_platform.processor())
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
 
 
 def setup_jax(cache_dir: str | None = None) -> None:
@@ -33,13 +65,30 @@ def setup_jax(cache_dir: str | None = None) -> None:
         except Exception:  # noqa: BLE001
             pass
 
-    if platform == "cpu" and cache_dir is None:
-        # No persistent compile cache for CPU-pinned processes: reloading a
-        # serialized XLA:CPU executable has been observed to SIGSEGV in this
-        # environment (cpu_aot_loader feature-mismatch path — the entry
-        # embeds compile-machine pseudo-features like +prefer-no-scatter
-        # that host detection never reports). CPU compiles are cheap; the
-        # cache's value is the TPU path, which keeps it.
+    # No persistent compile cache for CPU-resolved processes, however the
+    # pin arrived (TPUML_PLATFORM, JAX_PLATFORMS env, or an earlier
+    # jax.config.update as in tests/driver dryruns): reloading a serialized
+    # XLA:CPU executable has been observed to SIGSEGV in this environment,
+    # and even same-host reloads always log cpu_aot_loader feature-mismatch
+    # errors (the entry embeds compile-machine pseudo-features like
+    # +prefer-no-scatter that host detection never reports). CPU compiles
+    # are cheap; the cache's value is the TPU path, which keeps it.
+    try:
+        configured = jax.config.jax_platforms or ""
+    except AttributeError:
+        configured = ""
+    resolved = platform or configured or os.environ.get("JAX_PLATFORMS", "")
+    if not resolved and cache_dir is None:
+        # no pin anywhere: ask the backend (this initializes it, but only
+        # on plugin-less machines — pinned/plugin processes resolve above
+        # without the touch, and the axon sitecustomize always pins)
+        try:
+            resolved = jax.default_backend()
+        except Exception:  # noqa: BLE001 — no backend at all: run uncached
+            return
+    # only the FIRST entry is the default backend: the axon plugin pins
+    # "axon,cpu" (cpu as fallback only), which must keep the TPU cache
+    if str(resolved).split(",")[0].strip() == "cpu" and cache_dir is None:
         return
 
     if cache_dir is None:
@@ -56,6 +105,7 @@ def setup_jax(cache_dir: str | None = None) -> None:
             os.environ.get("XLA_FLAGS", ""),
             os.environ.get("JAX_PLATFORMS", ""),
             platform or "",
+            host_fingerprint(),
         ))
         sig = hashlib.sha256(ctx.encode()).hexdigest()[:10]
         cache_dir = os.path.join(

@@ -50,8 +50,47 @@ def test_cache_dir_partitioned_by_context():
         "import jax\n"
         "print('CACHEDIR=' + str(jax.config.jax_compilation_cache_dir))\n"
     )
-    a = _run(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=2"})
-    b = _run(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=4"})
+    # JAX_PLATFORMS=tpu (not cleared): a cpu-resolved process skips the
+    # persistent cache by design, and a CLEARED env on a plugin-less
+    # machine would resolve cpu too. The pin is only read for the cache
+    # decision — the script never touches the backend, so this works on
+    # accelerator-less hosts.
+    a = _run(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                      "JAX_PLATFORMS": "tpu"})
+    b = _run(script, {"XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                      "JAX_PLATFORMS": "tpu"})
+    assert a.returncode == 0 and b.returncode == 0, (a.stderr[-300:], b.stderr[-300:])
+    da = a.stdout.split("CACHEDIR=")[1].strip()
+    db = b.stdout.split("CACHEDIR=")[1].strip()
+    assert da != db and da != "None" and db != "None", (da, db)
+
+
+def test_cache_dir_partitioned_by_host_fingerprint():
+    """Hosts with different CPU capability sets must never share a cache
+    subdirectory (cpu_aot_loader feature-mismatch -> SIGILL hazard on
+    heterogeneous fleets sharing a storage root)."""
+    from cs230_distributed_machine_learning_tpu.utils.jax_setup import (
+        host_fingerprint,
+    )
+
+    fp = host_fingerprint()
+    assert fp and len(fp) == 16
+    # deterministic on one host
+    assert host_fingerprint() == fp
+
+
+def test_host_fingerprint_in_cache_dir():
+    """The resolved cache dir must change when the host fingerprint does —
+    patched via the module hook so the test exercises setup_jax itself."""
+    script = (
+        "from cs230_distributed_machine_learning_tpu.utils import jax_setup\n"
+        "jax_setup.host_fingerprint = lambda: {fp!r}\n"
+        "jax_setup.setup_jax()\n"
+        "import jax\n"
+        "print('CACHEDIR=' + str(jax.config.jax_compilation_cache_dir))\n"
+    )
+    a = _run(script.format(fp="host-a" * 3), {"JAX_PLATFORMS": "tpu"})
+    b = _run(script.format(fp="host-b" * 3), {"JAX_PLATFORMS": "tpu"})
     assert a.returncode == 0 and b.returncode == 0, (a.stderr[-300:], b.stderr[-300:])
     da = a.stdout.split("CACHEDIR=")[1].strip()
     db = b.stdout.split("CACHEDIR=")[1].strip()
