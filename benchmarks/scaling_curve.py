@@ -98,7 +98,28 @@ def main() -> None:
         sk_time = None
         sk_cv = None
         extrapolated = False
-        if not sk_skipped:
+        reused = None
+        if os.environ.get("CS230_SCALING_REUSE_SK") == "1":
+            # framework-side sweeps: reuse the committed sklearn point for
+            # this fraction instead of burning ~8 min re-measuring it
+            out_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "SCALING_MEASURED.json"
+            )
+            try:
+                with open(out_path) as f:
+                    old = json.load(f)
+                reused = next(
+                    (p for p in old.get("points", [])
+                     if p.get("fraction") == frac and old.get("model") == MODEL),
+                    None,
+                )
+            except (OSError, ValueError):
+                pass
+        if reused is not None:
+            sk_time = reused["sklearn_s"]
+            sk_cv = reused.get("cv_sklearn")
+            extrapolated = bool(reused.get("sklearn_extrapolated"))
+        elif not sk_skipped:
             model = _estimator()
             t0 = time.time()
             Xt, Xe, yt, ye = train_test_split(Xf, yf, test_size=0.2, random_state=42)

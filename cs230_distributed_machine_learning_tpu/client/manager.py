@@ -130,6 +130,18 @@ class MLTaskManager:
         if self._coordinator is not None:
             submit = self._coordinator.submit_train(self.session_id, payload)
         else:
+            scoring = (model_details.get("cv_params") or {}).get("scoring")
+            if callable(scoring) and not isinstance(scoring, str):
+                # json_safe would stringify the function into an
+                # unsupported-scorer name server-side — fail HERE with the
+                # real reason instead (callables work in local mode, where
+                # the object reaches the executor's host-side fallback)
+                raise ValueError(
+                    "callable scoring cannot be sent over the REST "
+                    "transport (it is not JSON-serializable); use a scorer "
+                    "name, or a local-mode MLTaskManager for callable "
+                    "scorers"
+                )
             submit = self._request(
                 "post", f"train/{self.session_id}", json=json_safe(payload)
             )

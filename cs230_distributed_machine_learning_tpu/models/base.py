@@ -32,8 +32,10 @@ import numpy as np
 from ..ops.metrics import (
     classification_score,
     margin_score,
+    proba_score,
     regression_score,
     scoring_needs_margin,
+    scoring_needs_proba,
     weighted_mse,
 )
 
@@ -145,6 +147,11 @@ class ModelKernel(abc.ABC):
             if scoring_needs_margin(scoring):
                 margin = self.predict_margin(params, X, static)
                 return {"score": margin_score(scoring, y, margin, w)}
+            if scoring_needs_proba(scoring):
+                proba = self.predict_proba(params, X, static)
+                return {"score": proba_score(
+                    scoring, y, proba, w, static.get("_n_classes", 2)
+                )}
             y_pred = self.predict(params, X, static)
             return {
                 "score": classification_score(
@@ -165,6 +172,17 @@ class ModelKernel(abc.ABC):
             f"scoring requires a decision margin, which the {self.name} "
             "kernel does not expose (supported: kernels overriding "
             "predict_margin)"
+        )
+
+    def predict_proba(self, params, X, static: Dict[str, Any]):
+        """Class-probability matrix [n, n_classes] — required by
+        probability scorers (neg_log_loss, roc_auc_ovr/ovo). Kernels with
+        natural probabilities (softmax logits, leaf class distributions,
+        likelihoods) override this."""
+        raise NotImplementedError(
+            f"scoring requires class probabilities, which the {self.name} "
+            "kernel does not expose (supported: kernels overriding "
+            "predict_proba)"
         )
 
     # Rough per-trial working-set estimate in MB, used by the placement

@@ -142,6 +142,13 @@ class LogisticRegressionKernel(ModelKernel):
         Z = A @ params
         return Z[:, 1] - Z[:, 0]
 
+    def predict_proba(self, params, X, static: Dict[str, Any]):
+        """Softmax class probabilities (sklearn's multinomial
+        predict_proba up to solver tolerance)."""
+        fit_intercept = bool(static.get("fit_intercept", True))
+        A = add_intercept(X, fit_intercept)
+        return jax.nn.softmax(A @ params, axis=-1)
+
     def memory_estimate_mb(self, n, d, static):
         # marginal per-(trial,split) working set: a few [n, c] activation/
         # gradient buffers (the [n, d] design matrix is shared, not vmapped)

@@ -78,6 +78,19 @@ class _MLPBase(ModelKernel):
         epochs = min(int(static.get("max_iter", 200)), _EPOCH_CAP)
         if static.get("activation", "relu") not in ("relu", "tanh", "logistic", "identity"):
             raise ValueError(f"MLP: unsupported activation {static.get('activation')!r}")
+        if static.get("solver", "adam") not in ("adam", "sgd"):
+            # lbfgs would silently train with the wrong optimizer — the
+            # reference's sklearn honors it, so fail loudly instead
+            raise ValueError(
+                f"MLP: unsupported solver {static.get('solver')!r} "
+                "(supported: adam, sgd)"
+            )
+        if static.get("learning_rate", "constant") not in (
+            "constant", "invscaling", "adaptive"
+        ):
+            raise ValueError(
+                f"MLP: unsupported learning_rate {static.get('learning_rate')!r}"
+            )
         return {
             **static,
             "_hls": hls,
@@ -212,8 +225,70 @@ class _MLPBase(ModelKernel):
         perm_keys = jax.random.split(key, epochs)
         batches = jax.vmap(epoch_perm)(perm_keys).reshape(-1, bs)
 
+        if static.get("solver", "adam") == "sgd":
+            return self._fit_sgd(
+                X, target, w, params, batches.reshape(epochs, n_batches, bs),
+                loss_fn, lr, static, n,
+            )
+
         (params, _, _, _), _ = jax.lax.scan(
             step, (params, m0, v0, jnp.asarray(0.0)), batches
+        )
+        return params
+
+    def _fit_sgd(self, X, target, w, params, batches, loss_fn, lr0, static, n):
+        """sklearn SGDOptimizer semantics: velocity momentum (plain or
+        Nesterov) with the three learning-rate schedules —
+        ``constant``; ``invscaling`` lr = lr_init / (t+1)^power_t with t
+        advancing by n samples per epoch (sklearn's ``t_``); ``adaptive``
+        divides lr by 5 once the epoch loss fails to improve by ``tol``
+        for n_iter_no_change+1 consecutive epochs (floored at 1e-6).
+        Like the Adam path, tol-based EARLY STOPPING is not applied — the
+        full max_iter budget runs (a documented simplification; the lr
+        schedule itself is honored)."""
+        momentum = float(static.get("momentum", 0.9))
+        nesterov = bool(static.get("nesterovs_momentum", True))
+        schedule = static.get("learning_rate", "constant")
+        power_t = float(static.get("power_t", 0.5))
+        tol = float(static.get("tol", 1e-4))
+        no_change = int(static.get("n_iter_no_change", 10))
+        tmap = jax.tree_util.tree_map
+
+        def batch_step(carry, idx):
+            p, vel, lr_t = carry
+            loss, g = jax.value_and_grad(loss_fn)(p, X[idx], target[idx], w[idx])
+            vel = tmap(lambda v, gg: momentum * v - lr_t * gg, vel, g)
+            if nesterov:
+                p = tmap(lambda a, v, gg: a + momentum * v - lr_t * gg, p, vel, g)
+            else:
+                p = tmap(lambda a, v: a + v, p, vel)
+            return (p, vel, lr_t), loss
+
+        def epoch_step(carry, ebatches):
+            p, vel, lr_t, t_samples, best, wait = carry
+            (p, vel, _), losses = jax.lax.scan(
+                batch_step, (p, vel, lr_t), ebatches
+            )
+            epoch_loss = jnp.mean(losses)
+            t_samples = t_samples + n
+            if schedule == "invscaling":
+                lr_t = lr0 / (t_samples + 1.0) ** power_t
+            elif schedule == "adaptive":
+                improved = epoch_loss < best - tol
+                wait = jnp.where(improved, 0, wait + 1)
+                cut = wait > no_change
+                lr_t = jnp.where(cut, jnp.maximum(lr_t / 5.0, 1e-6), lr_t)
+                wait = jnp.where(cut, 0, wait)
+                best = jnp.minimum(best, epoch_loss)
+            return (p, vel, lr_t, t_samples, best, wait), None
+
+        vel0 = tmap(jnp.zeros_like, params)
+        (params, _, _, _, _, _), _ = jax.lax.scan(
+            epoch_step,
+            (params, vel0, lr0 * jnp.asarray(1.0, jnp.float32),
+             jnp.asarray(0.0, jnp.float32),
+             jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(0, jnp.int32)),
+            batches,
         )
         return params
 
@@ -231,16 +306,18 @@ class _MLPBase(ModelKernel):
     batched_chunk_cap = 64
 
     def batched_applicable(self, static: Dict[str, Any], n: int, d: int) -> bool:
-        if static.get("solver", "adam") != "adam":
+        solver = static.get("solver", "adam")
+        if solver not in ("adam", "sgd"):
             return False
-        if static.get("learning_rate", "constant") != "constant":
-            return False
+        # learning_rate schedules are sgd-only in sklearn (adam ignores
+        # them); all three ride the fused path — constant/invscaling as a
+        # per-epoch lr column, adaptive via the kernel's epoch-loss slab
         if not static.get("shuffle", True) or static.get("early_stopping"):
             return False
         if len(static["_hls"]) > 3:
             return False
-        if static["_bs"] % 8:  # TPU sublane rule for the batch blocks
-            return False
+        # non-8-multiple batch sizes pad each batch block with zero-weight
+        # slots (sublane rule); no eligibility cut needed
         if _interpret_mode():
             return True
         return jax.default_backend() == "tpu" and n >= 4096
@@ -263,17 +340,28 @@ class _MLPBase(ModelKernel):
         epochs = int(static["_epochs"])
         n_batches = max(1, n // bs)
         R = n_batches * bs
+        # TPU sublane rule: batch blocks pad to a multiple of 8 rows; pad
+        # slots replay row 0 with zero weight (no gradient contribution)
+        bs_pad = -(-bs // 8) * 8
         S = int(n_splits)
         L0 = chunk * S
-        k = pick_k(dims, bs)
+        solver = static.get("solver", "adam")
+        schedule = static.get("learning_rate", "constant")
+        adaptive = solver == "sgd" and schedule == "adaptive"
+        k = pick_k(dims, bs_pad, solver=solver)
         Lk = -(-L0 // k) * k
         seed = int(static["_seed"])
         b1 = float(static.get("beta_1", 0.9))
         b2 = float(static.get("beta_2", 0.999))
         eps = float(static.get("epsilon", 1e-8))
+        momentum = float(static.get("momentum", 0.9))
+        nesterov = bool(static.get("nesterovs_momentum", True))
+        power_t = float(static.get("power_t", 0.5))
+        tol = float(static.get("tol", 1e-4))
+        no_change = int(static.get("n_iter_no_change", 10))
         # the kernel hardcodes sklearn's Adam constants; non-default values
         # must take the generic path, which honors them
-        if (b1, b2, eps) != (0.9, 0.999, 1e-8):
+        if solver == "adam" and (b1, b2, eps) != (0.9, 0.999, 1e-8):
             return None
 
         # lane = trial * S + split; padded lanes replay lane 0 (discarded)
@@ -283,8 +371,9 @@ class _MLPBase(ModelKernel):
         )
         lane_split = jnp.asarray(ls_np)
         epoch_fn = build_epoch_fn(
-            dims, act, bs, n_batches, Lk, k, classification,
-            interpret=interpret,
+            dims, act, bs_pad, n_batches, Lk, k, classification,
+            solver=solver, momentum=momentum, nesterov=nesterov,
+            track_loss=adaptive, interpret=interpret,
         )
 
         def _lane_vec(h):  # [chunk] hyper -> [Lk, 1] per-lane column
@@ -311,39 +400,114 @@ class _MLPBase(ModelKernel):
             key = jax.random.PRNGKey(seed)
             key, init_key = jax.random.split(key)
             params = self._init(init_key, dims)
+            per_layer = 6 if solver == "adam" else 4
+            n_moments = 2 if solver == "adam" else 1
             state = []
             for layer in params:
                 # biases ride as [Lk, 8, out] row-identical slabs (see
                 # ops/pallas_mlp.py kernel docstring for the layout rule)
                 for leaf in (layer["W"], jnp.tile(layer["b"][None, :], (8, 1))):
                     state.append(jnp.tile(leaf[None], (Lk,) + (1,) * leaf.ndim))
-                    state.append(jnp.zeros((Lk,) + leaf.shape, jnp.float32))
-                    state.append(jnp.zeros((Lk,) + leaf.shape, jnp.float32))
-            # reorder to the kernel's per-layer (pW, pB, mW, mB, vW, vB)
+                    for _ in range(n_moments):
+                        state.append(jnp.zeros((Lk,) + leaf.shape, jnp.float32))
+            # reorder to the kernel's per-layer layout: (pW, pB, mW, mB,
+            # vW, vB) for adam, (pW, pB, velW, velB) for sgd
+            half = 1 + n_moments
             flat = []
             for li in range(len(params)):
-                pW, mW, vW, pB, mB, vB = state[6 * li : 6 * (li + 1)]
-                flat.extend([pW, pB, mW, mB, vW, vB])
+                chunk6 = state[2 * half * li : 2 * half * (li + 1)]
+                Wslabs, Bslabs = chunk6[:half], chunk6[half:]
+                flat.extend([Wslabs[0], Bslabs[0]])
+                for j in range(1, half):
+                    flat.extend([Wslabs[j], Bslabs[j]])
             state = flat
+            if adaptive:
+                state.append(jnp.zeros((Lk, 8, 128), jnp.float32))
 
             ekeys = jax.random.split(key, epochs)
             t0s = jnp.arange(epochs, dtype=jnp.int32) * n_batches
 
-            def body(st, xs):
-                key_e, t0 = xs
-                perm = jax.random.permutation(key_e, n)[:R]
-                Wl = TWf[:, perm].T[:, lane_split]  # [R, Lk], lane-minor
-                st = epoch_fn(
-                    Xb[perm], Y[perm], Wl, lr, alpha,
-                    t0.reshape(1, 1), st,
+            if bs_pad != bs:
+                pad_mask = jnp.asarray(
+                    np.concatenate(
+                        [np.ones((n_batches, bs), np.float32),
+                         np.zeros((n_batches, bs_pad - bs), np.float32)], 1
+                    ).reshape(-1)
                 )
-                return st, None
+            else:
+                pad_mask = None
 
-            state, _ = jax.lax.scan(body, state, (ekeys, t0s))
+            def _epoch_rows(perm):
+                if bs_pad == bs:
+                    return perm
+                idx = perm.reshape(n_batches, bs)
+                return jnp.concatenate(
+                    [idx, jnp.zeros((n_batches, bs_pad - bs), idx.dtype)], 1
+                ).reshape(-1)
+
+            def _run_epoch(st, key_e, t0, lr_col):
+                perm = jax.random.permutation(key_e, n)[:R]
+                idx = _epoch_rows(perm)
+                Wl = TWf[:, idx].T[:, lane_split]  # [Rp, Lk], lane-minor
+                if pad_mask is not None:
+                    Wl = Wl * pad_mask[:, None]
+                return epoch_fn(
+                    Xb[idx], Y[idx], Wl, lr_col, alpha,
+                    t0.reshape(1, 1), st,
+                ), Wl
+
+            if not adaptive:
+                def body(st, xs):
+                    key_e, t0 = xs
+                    if solver == "sgd" and schedule == "invscaling":
+                        # sklearn t_ advances by n samples per epoch
+                        e = (t0 // n_batches).astype(jnp.float32)
+                        lr_col = lr / (e * n + 1.0) ** power_t
+                    else:
+                        lr_col = lr
+                    st, _ = _run_epoch(st, key_e, t0, lr_col)
+                    return st, None
+
+                state, _ = jax.lax.scan(body, state, (ekeys, t0s))
+            else:
+                def body(carry, xs):
+                    st, lr_col, best, wait = carry
+                    key_e, t0 = xs
+                    st = st[:-1] + [jnp.zeros_like(st[-1])]  # reset loss acc
+                    st, Wl = _run_epoch(st, key_e, t0, lr_col)
+                    data_loss = st[-1][:, 0, 0] / n_batches  # [Lk]
+                    # L2 term added host-side from end-of-epoch weights
+                    # (sklearn accumulates it per batch; the improvement
+                    # signal only needs epoch resolution)
+                    l2 = jnp.zeros((Lk,), jnp.float32)
+                    for li in range(len(params)):
+                        Wli = st[per_layer * li]
+                        l2 = l2 + jnp.sum(
+                            Wli.astype(jnp.float32) ** 2,
+                            axis=tuple(range(1, Wli.ndim)),
+                        )
+                    bw_mean = jnp.maximum(jnp.sum(Wl, axis=0) / n_batches, 1e-12)
+                    epoch_loss = data_loss + 0.5 * alpha[:, 0] * l2 / bw_mean
+                    improved = epoch_loss < best - tol
+                    wait = jnp.where(improved, 0, wait + 1)
+                    cut = wait > no_change
+                    lr_col = jnp.where(
+                        cut[:, None], jnp.maximum(lr_col / 5.0, 1e-6), lr_col
+                    )
+                    wait = jnp.where(cut, 0, wait)
+                    best = jnp.minimum(best, epoch_loss)
+                    return (st, lr_col, best, wait), None
+
+                carry0 = (
+                    state, lr,  # [Lk, 1] per-lane column (mutated by cuts)
+                    jnp.full((Lk,), jnp.inf, jnp.float32),
+                    jnp.zeros((Lk,), jnp.int32),
+                )
+                (state, _, _, _), _ = jax.lax.scan(body, carry0, (ekeys, t0s))
 
             # ---- eval (XLA): weighted score per lane over row chunks ----
-            pWs = [state[6 * li] for li in range(len(params))]
-            pBs = [state[6 * li + 1][:, 0:1, :] for li in range(len(params))]
+            pWs = [state[per_layer * li] for li in range(len(params))]
+            pBs = [state[per_layer * li + 1][:, 0:1, :] for li in range(len(params))]
             act_f = _act(act)
             Xe = jnp.pad(Xb, ((0, n_pad - n), (0, 0)))
             EWp = jnp.pad(EW.astype(jnp.float32), ((0, 0), (0, n_pad - n)))
@@ -434,6 +598,10 @@ class MLPClassifierKernel(_MLPBase):
     def predict_margin(self, params, X, static: Dict[str, Any]):
         logits = self._forward(params, X.astype(jnp.float32), static)
         return logits[:, 1] - logits[:, 0]
+
+    def predict_proba(self, params, X, static: Dict[str, Any]):
+        logits = self._forward(params, X.astype(jnp.float32), static)
+        return jax.nn.softmax(logits, axis=-1)
 
 
 class MLPRegressorKernel(_MLPBase):

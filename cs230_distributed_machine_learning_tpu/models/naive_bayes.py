@@ -59,6 +59,10 @@ class GaussianNBKernel(ModelKernel):
         lj = self._log_joint(params, X)
         return lj[:, 1] - lj[:, 0]
 
+    def predict_proba(self, params, X, static: Dict[str, Any]):
+        """Normalized joint likelihood (sklearn GaussianNB.predict_proba)."""
+        return jax.nn.softmax(self._log_joint(params, X), axis=-1)
+
 
 class _DecisionTreeBase(_TreeBase):
     _supports_deep = True  # sklearn default max_depth=None grows to purity
@@ -109,6 +113,13 @@ class DecisionTreeClassifierKernel(_DecisionTreeBase):
         xq = self._query_bins(params, X, static)
         proba = self._tree_predict(xq, params["tree"], static)
         return proba[:, 1] - proba[:, 0]
+
+    def predict_proba(self, params, X, static):
+        """Leaf class distribution (sklearn tree predict_proba); rows are
+        S/C leaf frequencies, re-normalized defensively for empty leaves."""
+        xq = self._query_bins(params, X, static)
+        proba = self._tree_predict(xq, params["tree"], static)
+        return proba / jnp.maximum(jnp.sum(proba, axis=-1, keepdims=True), 1e-12)
 
 
 class DecisionTreeRegressorKernel(_DecisionTreeBase):

@@ -232,6 +232,13 @@ def _project_box_hyperplane(a_raw, t, lo, hi, iters: int = 30):
     return jnp.clip(a_raw - 0.5 * (lo_l + hi_l) * t, lo, hi)
 
 
+def _kkt_tol() -> float:
+    """Early-stop tolerance for the dual ascent: iterate-displacement
+    residual relative to the box scale (C). 0 disables the stop (fixed
+    step count — the pre-r5 behavior, and the A/B baseline)."""
+    return float(os.environ.get("CS230_SVM_KKT_TOL", "1e-3"))
+
+
 def _constrained_dual_ascent(Q, lin, t, lo, hi, steps=None, diag=0.0):
     """max_a lin.a - 0.5 a'Qa s.t. lo <= a <= hi AND sum(t*a) = 0 — the
     C-SVM dual's REAL constraint set (libsvm semantics). The box-only form
@@ -239,9 +246,23 @@ def _constrained_dual_ascent(Q, lin, t, lo, hi, steps=None, diag=0.0):
     which costs accuracy on unbalanced class pairs; projecting onto the
     box∩hyperplane intersection (bisection, _project_box_hyperplane) each
     step solves the constrained dual directly, and the intercept comes
-    from the KKT conditions afterwards."""
+    from the KKT conditions afterwards.
+
+    r5: FISTA acceleration + KKT-residual early stop. Plain projected
+    ascent with the 1/L step needs O(kappa) iterations; the Nesterov
+    t-sequence extrapolation (accelerated projected gradient on the
+    equivalent convex minimization) gets O(sqrt(kappa)) at identical
+    per-step cost — the step is still one [n,n] matvec, the fit's
+    HBM-bound term. The while_loop stops once the projected-iterate
+    displacement falls below ``_kkt_tol() x box scale`` (a stationarity
+    certificate for the projection operator: a fixed point of
+    P_C(a + eta*grad) IS a KKT point), so easy (large-C-margin or
+    small-subset OvO) machines stop in tens of iterations instead of
+    burning the full budget. vmapped lanes run until the SLOWEST lane
+    converges — still bounded by ``steps``."""
     if steps is None:
         steps = int(os.environ.get("CS230_SVM_PG_STEPS", _PG_STEPS))
+    tol = _kkt_tol()
     eta = _lipschitz_eta(Q)
 
     # the ascent is HBM-bound, not FLOP-bound: the [n, n] kernel operand
@@ -254,14 +275,25 @@ def _constrained_dual_ascent(Q, lin, t, lo, hi, steps=None, diag=0.0):
     # stability ridge analytically in f32 — 1e-6 is below bf16 resolution
     # near 1.0, so it cannot ride inside a bf16 matrix.
 
-    def body(a, _):
-        g = lin - _matvec_f32(Q, a) - diag * a
-        a = _project_box_hyperplane(a + eta * g, t, lo, hi)
-        return a, None
+    scale = jnp.maximum(jnp.max(hi - lo), 1e-12)
+
+    def cond(carry):
+        a, a_prev, tk, k, res = carry
+        live = res > tol * scale if tol > 0 else jnp.bool_(True)
+        return (k < steps) & live
+
+    def body(carry):
+        a, a_prev, tk, k, _ = carry
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        y = a + ((tk - 1.0) / t_next) * (a - a_prev)
+        g = lin - _matvec_f32(Q, y) - diag * y
+        a_new = _project_box_hyperplane(y + eta * g, t, lo, hi)
+        res = jnp.max(jnp.abs(a_new - a))
+        return (a_new, a, t_next, k + 1, res)
 
     a0 = jnp.zeros((Q.shape[0],), jnp.float32)
-    a, _ = jax.lax.scan(body, a0, None, length=steps)
-    return a
+    carry = (a0, a0, jnp.float32(1.0), jnp.int32(0), jnp.float32(jnp.inf))
+    return jax.lax.while_loop(cond, body, carry)[0]
 
 
 class SVCKernel(ModelKernel):
@@ -279,6 +311,7 @@ class SVCKernel(ModelKernel):
             _nystrom_steps(),
             _kmeans_iters(),
             os.environ.get("CS230_SVM_NYSTROM_M", ""),
+            os.environ.get("CS230_SVM_KKT_TOL", ""),
         )
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):

@@ -91,6 +91,14 @@ _DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "48"))
 #: bins cap when the TOP width band is in play (n > 49152): the measured
 #: constant-cost width/bins trade above
 _DEEP_BINS_WIDE = int(os.environ.get("CS230_DEEP_BINS_WIDE", "24"))
+#: r5 adaptive bin resolution (ops/trees.build_tree_deep nb_schedule):
+#: candidate evaluation runs at the full (fine) binning while the
+#: candidate frontier has <= _DEEP_BINS_OCC nodes — early splits on BIG
+#: nodes get fine thresholds — and at _DEEP_BINS_DEEP once wide, where the
+#: frontier-width x bins product is the profiled per-level MXU cost.
+#: 0 disables (single resolution everywhere).
+_DEEP_BINS_OCC = int(os.environ.get("CS230_DEEP_BINS_OCC", "256"))
+_DEEP_BINS_DEEP = int(os.environ.get("CS230_DEEP_BINS_DEEP", "24"))
 
 
 _deep_w_force_warned: set = set()
@@ -178,6 +186,10 @@ class _TreeBase(ModelKernel):
             os.environ.get("CS230_HIST_BLOCK_ROWS", ""),
             os.environ.get("CS230_HIST_BLOCK_NODES", ""),
             os.environ.get("CS230_COARSE_BINS", ""),
+            os.environ.get("CS230_TREE_GROUP_MB", ""),
+            os.environ.get("CS230_DEEP_NBSCHED", ""),
+            os.environ.get("CS230_DEEP_BINS_OCC", ""),
+            os.environ.get("CS230_DEEP_BINS_DEEP", ""),
         )
     #: sklearn semantics grow this family to purity (RF/DecisionTree) —
     #: eligible for the deep frontier-compacted builder on large data
@@ -257,10 +269,30 @@ class _TreeBase(ModelKernel):
             # coarser quantile bins in the deep arena (see sweep table at
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
             # like the depth caps, this deliberately overrides a finer
-            # user-requested binning for the deep path only
-            if "n_bins" in static and n_bins > bins_cap:
-                _warn_deep_bins_clamp(n_bins, bins_cap)
-            n_bins = min(n_bins, bins_cap)
+            # user-requested binning for the deep path only.
+            #
+            # r5: BINNING stays at the fine cap (_DEEP_BINS_CAP, 48);
+            # bins_cap (24 at the wide band) becomes the DEEP-level
+            # resolution of the adaptive nb_schedule instead of a global
+            # clamp — early/narrow-frontier candidates keep the fine
+            # thresholds (big-node splits are where resolution buys CV),
+            # wide frontiers pay only the coarse bin axis.
+            fine_cap = max(_DEEP_BINS_CAP, bins_cap)
+            eff_fine = min(n_bins, fine_cap)
+            deep_nb = min(eff_fine, min(bins_cap, _DEEP_BINS_DEEP))
+            sched_ok = (
+                _DEEP_BINS_OCC > 0
+                and deep_nb < eff_fine
+                and eff_fine % deep_nb == 0
+            )
+            # warn against the cap that will ACTUALLY apply: the fine cap
+            # when the adaptive schedule engages, the flat deep cap when it
+            # does not (disabled/non-dividing resolutions)
+            cap_used = fine_cap if sched_ok else bins_cap
+            if "n_bins" in static and n_bins > cap_used:
+                _warn_deep_bins_clamp(n_bins, cap_used)
+            n_bins = min(n_bins, cap_used)
+            nb_sched = (_DEEP_BINS_OCC, deep_nb) if sched_ok else None
         elif depth is None:
             # small data: the complete-tree builder to ~log2(n) levels is
             # already near-purity and cheaper to compile than the arena
@@ -287,6 +319,8 @@ class _TreeBase(ModelKernel):
             out["_deep"] = True
             out["_levels"] = levels
             out["_W"] = width
+            if nb_sched is not None:
+                out["_nb_sched"] = nb_sched
             if width >= 1024 and n > 80_000 and grow_to_purity and not force_w:
                 # decaying width schedule at full scale: per-level cost is
                 # linear in frontier width and the deepest levels split
@@ -337,7 +371,14 @@ class _TreeBase(ModelKernel):
             # form; past _LOOKUP_M the builder switches to segment_sum
             m_leaf = 2**depth if 2**depth <= _LOOKUP_M else 0
             route = 6.0 * n * m_route + 4.0 * n * m_leaf
-        return max(1.0, (hist + route + 4.0 * n * d * 2) / 1e6)
+        # forest kernels fit T trees concurrently (_tree_group_size): their
+        # per-tree buffers coexist, so the engine's lane throttle must see
+        # the multiplied working set
+        group = (
+            self._tree_group_size(n, d, static)
+            if hasattr(self, "_tree_group_size") else 1
+        )
+        return max(1.0, (group * (hist + route) + 4.0 * n * d * 2) / 1e6)
 
     @staticmethod
     def _hist_cols(static, d, prepared=None):
@@ -347,6 +388,11 @@ class _TreeBase(ModelKernel):
         from ..ops.trees import COARSE_BINS
 
         n_bins = int(static.get("_n_bins", 128))
+        sched = static.get("_nb_sched")
+        if sched:
+            # adaptive resolution: the wide (deep) levels dominate the
+            # MAC-weighted level sum, so cost at the deep resolution
+            n_bins = int(sched[1])
         if (
             prepared is not None
             and isinstance(prepared, dict)
@@ -414,7 +460,8 @@ class _TreeBase(ModelKernel):
                           ("xb_cont", "xb_coarse", "fid_cont", "fid_coarse")}
             return build_tree_deep(
                 xb, S, C, levels=static["_levels"], width=static["_W"],
-                groups=groups, w_schedule=static.get("_wsched"), **common
+                groups=groups, w_schedule=static.get("_wsched"),
+                nb_schedule=static.get("_nb_sched"), **common
             )
         return build_tree(xb, S, C, depth=static["_depth"], **common)
 
@@ -529,15 +576,71 @@ class _RandomForestBase(_TreeBase):
             ),
         )
 
+    def _tree_group_size(self, n: int, d: int, static: Dict[str, Any]) -> int:
+        """Trees fitted CONCURRENTLY per sequential step (an inner vmap
+        inside the tree loop). At small n the per-level ops are latency-
+        bound, not bandwidth-bound — profiled on-device: lax.top_k cost is
+        FLAT in the vmapped lane count, and the histogram's marginal
+        per-lane cost is ~60% of its solo cost — so running trees one at a
+        time wastes most of each level's fixed cost. The group is sized by
+        a per-lane memory budget: at full-Covertype shapes (W=1024) the
+        candidate-histogram buffers alone are GBs and T collapses to 1,
+        which is also the bandwidth-bound regime where batching stops
+        paying. Keys stay fold_in(t), so grouped, sequential, and chunked
+        fits of one config produce bit-identical trees."""
+        kk = (
+            max(int(static.get("_n_classes", 2)), 2) + 1
+            if self.task == "classification"
+            else 2
+        )
+        n_bins = int(static.get("_n_bins", 128))
+        if static.get("_deep"):
+            W = int(static["_W"])
+            route_w = W
+        else:
+            from ..ops.trees import _LOOKUP_M
+
+            W = 2 ** max(int(static.get("_depth", 8)) - 1, 1)
+            route_w = min(W, _LOOKUP_M)
+        # per-tree working set: ~4 live candidate-histogram buffers
+        # [2W, d, nb, kk] f32 + the [n, W] routing masks (~6 B/elem) +
+        # per-row stat/leaf vectors
+        per_tree_mb = (
+            4.0 * 2 * W * d * n_bins * kk * 4
+            + 6.0 * n * route_w
+            + 16.0 * n * kk
+        ) / 1e6
+        # DEFAULT 64 MB => T=1 at every realistic shape: tree batching is a
+        # MEASURED NEGATIVE on the tunneled v5e (10% Covertype RF-100
+        # steady: T=1 10.0 s, T=2 11.6 s, T=5 13.4 s — the batched levels'
+        # histogram working set multiplies while none of the level ops turn
+        # out to be latency-bound enough to amortize). The knob stays for
+        # hardware where the trade differs; it keys trace_salt.
+        budget = float(os.environ.get("CS230_TREE_GROUP_MB", 64))
+        return int(max(1, min(8, budget / max(per_tree_mb, 1.0))))
+
     def _fit_forest(self, X, S, C, static):
         n_trees = int(static.get("n_estimators", 100))
         base_key = jax.random.PRNGKey(static["_seed"])
+        xb = X["xb"] if isinstance(X, dict) else X
+        T = self._tree_group_size(xb.shape[0], xb.shape[1], static)
+        G = -(-n_trees // T)
         # per-tree keys via fold_in(t) — the SAME stream the chunked paths
-        # use, so monolithic and chunked fits of one config are identical
+        # use, so monolithic and chunked fits of one config are identical.
+        # Padding trees (t >= n_trees) are fitted and sliced off (<= T-1
+        # wasted fits per forest).
         keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(
-            jnp.arange(n_trees)
+            jnp.arange(G * T)
         )
-        return jax.lax.map(lambda k: self._one_tree(X, S, C, static, k), keys)
+        fit_group = jax.vmap(lambda k: self._one_tree(X, S, C, static, k))
+        out = jax.lax.map(
+            fit_group, jax.tree_util.tree_map(
+                lambda a: a.reshape(G, T, *a.shape[1:]), keys
+            )
+        )
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape(G * T, *a.shape[2:])[:n_trees], out
+        )
 
     # ---- chunked-fit protocol (parallel/trial_map.py chunked path) ----
     # A forest fit on a large dataset is one long sequential device program
@@ -578,24 +681,36 @@ class _RandomForestBase(_TreeBase):
         n_trees = int(static.get("n_estimators", 100))
         g = plan["trees_per_chunk"]
         base_key = jax.random.PRNGKey(static["_seed"])
+        T = self._tree_group_size(xb.shape[0], xb.shape[1], static)
+        G = -(-g // T)
 
-        def one(carry, i):
+        def one_group(carry, gi):
+            i = gi * T + jnp.arange(T)
             t = chunk_idx * g + i
-            key = jax.random.fold_in(base_key, t)
-            tree = self._one_tree(X, S, C, static, key)
-            val = self._tree_predict(xb, tree, static)  # [n, k]
-            live = (t < n_trees).astype(jnp.float32)
-            return carry + live * val, None
+            keys = jax.vmap(lambda tt: jax.random.fold_in(base_key, tt))(t)
+            trees = jax.vmap(
+                lambda k: self._one_tree(X, S, C, static, k)
+            )(keys)
+            vals = jax.vmap(
+                lambda tr: self._tree_predict(xb, tr, static)
+            )(trees)  # [T, n, k]
+            # i < g guards group padding (those ids belong to the NEXT
+            # chunk, which will fit them itself — adding here would double
+            # count); t < n_trees guards the final chunk's tail
+            live = ((i < g) & (t < n_trees)).astype(jnp.float32)
+            return carry + jnp.sum(live[:, None, None] * vals, axis=0), None
 
-        state, _ = jax.lax.scan(one, state, jnp.arange(g))
+        state, _ = jax.lax.scan(one_group, state, jnp.arange(G))
         return state
 
     def chunk_eval(self, X, y, w_eval, hyper, static, state):
         from ..ops.metrics import (
             classification_score,
             margin_score,
+            proba_score,
             regression_score,
             scoring_needs_margin,
+            scoring_needs_proba,
             weighted_mse,
         )
 
@@ -605,6 +720,12 @@ class _RandomForestBase(_TreeBase):
         if self.task == "classification":
             if scoring_needs_margin(scoring):
                 return {"score": margin_score(scoring, y, mean[:, 1] - mean[:, 0], w_eval)}
+            if scoring_needs_proba(scoring):
+                proba = mean / jnp.maximum(
+                    jnp.sum(mean, axis=-1, keepdims=True), 1e-12
+                )
+                return {"score": proba_score(
+                    scoring, y, proba, w_eval, static.get("_n_classes", 2))}
             pred = jnp.argmax(mean, axis=-1).astype(jnp.int32)
             return {"score": classification_score(
                 scoring, y, pred, w_eval, static.get("_n_classes", 2))}
@@ -620,9 +741,20 @@ class _RandomForestBase(_TreeBase):
         S, _ = self._stat_matrix(y, w, static)
         g = plan["trees_per_chunk"]
         base_key = jax.random.PRNGKey(static["_seed"])
-        idx = chunk_idx * g + jnp.arange(g)
+        xb = X["xb"] if isinstance(X, dict) else X
+        T = self._tree_group_size(xb.shape[0], xb.shape[1], static)
+        G = -(-g // T)
+        idx = chunk_idx * g + jnp.arange(G * T)
         keys = jax.vmap(lambda t: jax.random.fold_in(base_key, t))(idx)
-        trees = jax.lax.map(lambda k: self._one_tree(X, S, w, static, k), keys)
+        trees = jax.lax.map(
+            jax.vmap(lambda k: self._one_tree(X, S, w, static, k)),
+            jax.tree_util.tree_map(
+                lambda a: a.reshape(G, T, *a.shape[1:]), keys
+            ),
+        )
+        trees = jax.tree_util.tree_map(
+            lambda a: a.reshape(G * T, *a.shape[2:])[:g], trees
+        )
         return carry, trees
 
     def assemble_artifact(self, trees, X, hyper, static, data_y, data_w):
@@ -633,11 +765,25 @@ class _RandomForestBase(_TreeBase):
 
     def _forest_leaf_mean(self, params, xq, static):
         trees = params["trees"]
+        n_trees = jax.tree_util.tree_leaves(trees)[0].shape[0]
+        T = max(1, min(
+            self._tree_group_size(xq.shape[0], xq.shape[1], static), n_trees
+        ))
+        G = -(-n_trees // T)
 
         def one(tree):
             return self._tree_predict(xq, tree, static)
 
-        vals = jax.lax.map(one, trees)  # [n_trees, nq, k]
+        # wrap-around padding to G*T (pad can exceed n_trees for tiny
+        # forests, so slice-padding is NOT enough); padded predictions are
+        # sliced off before the mean
+        idx = jnp.arange(G * T) % n_trees
+        grouped = jax.tree_util.tree_map(
+            lambda a: jnp.take(a, idx, axis=0).reshape(G, T, *a.shape[1:]),
+            trees,
+        )
+        vals = jax.lax.map(jax.vmap(one), grouped)  # [G, T, nq, k]
+        vals = vals.reshape(G * T, *vals.shape[2:])[:n_trees]
         return jnp.mean(vals, axis=0)
 
 
@@ -665,6 +811,13 @@ class RandomForestClassifierKernel(_RandomForestBase):
         xq = self._query_bins(params, X, static)
         proba = self._forest_leaf_mean(params, xq, static)
         return proba[:, 1] - proba[:, 0]
+
+    def predict_proba(self, params, X, static: Dict[str, Any]):
+        """Soft-vote mean of per-tree leaf class distributions (sklearn
+        forest predict_proba semantics)."""
+        xq = self._query_bins(params, X, static)
+        proba = self._forest_leaf_mean(params, xq, static)
+        return proba / jnp.maximum(jnp.sum(proba, axis=-1, keepdims=True), 1e-12)
 
 
 class RandomForestRegressorKernel(_RandomForestBase):
@@ -731,8 +884,10 @@ class _GradientBoostingBase(_TreeBase):
         from ..ops.metrics import (
             classification_score,
             margin_score,
+            proba_score,
             regression_score,
             scoring_needs_margin,
+            scoring_needs_proba,
             weighted_mse,
         )
 
@@ -743,6 +898,10 @@ class _GradientBoostingBase(_TreeBase):
                 # is just F[:, 1] - F[:, 0]
                 return {"score": margin_score(
                     scoring, y, state[:, 1] - state[:, 0], w_eval)}
+            if scoring_needs_proba(scoring):
+                return {"score": proba_score(
+                    scoring, y, jax.nn.softmax(state, axis=-1), w_eval,
+                    static.get("_n_classes", 2))}
             pred = jnp.argmax(state, axis=-1).astype(jnp.int32)
             return {"score": classification_score(
                 scoring, y, pred, w_eval, static.get("_n_classes", 2))}
@@ -928,6 +1087,10 @@ class GradientBoostingClassifierKernel(_GradientBoostingBase):
     def predict_margin(self, params, X, static: Dict[str, Any]):
         F = self._raw_scores(params, X, static)
         return F[:, 1] - F[:, 0]
+
+    def predict_proba(self, params, X, static: Dict[str, Any]):
+        """Softmax over raw boosting scores (sklearn GBT predict_proba)."""
+        return jax.nn.softmax(self._raw_scores(params, X, static), axis=-1)
 
 
 class GradientBoostingRegressorKernel(_GradientBoostingBase):

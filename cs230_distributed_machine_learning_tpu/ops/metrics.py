@@ -117,6 +117,94 @@ def weighted_balanced_accuracy(y_true, y_pred, w, n_classes):
     return jnp.sum(rec * present) / jnp.maximum(jnp.sum(present), _EPS)
 
 
+def weighted_log_loss(y_true, proba, w, n_classes):
+    """sklearn log_loss over kept rows: -mean log p(true class), with
+    sklearn's probability clipping (eps from the float dtype, matching
+    sklearn >= 1.5's default)."""
+    w = w.astype(jnp.float32)
+    eps = jnp.finfo(jnp.float32).eps
+    p = jnp.clip(proba, eps, 1.0 - eps)
+    # renormalize after clipping exactly as sklearn does
+    p = p / jnp.sum(p, axis=1, keepdims=True)
+    classes = jnp.arange(n_classes)
+    oh = (y_true[:, None] == classes[None, :]).astype(jnp.float32)
+    ll = -jnp.sum(oh * jnp.log(p), axis=1)
+    return jnp.sum(ll * w) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def weighted_average_precision(y_true, score, w):
+    """Binary average precision from a continuous score, tie-exact.
+
+    AP = sum over positive rows of precision-at-their-threshold / n_pos,
+    where precision at threshold t counts ALL rows with score >= t (the
+    whole tie group) — identical to sklearn's step-wise
+    average_precision_score. Masked rows are pushed to -inf in the count
+    tables so searchsorted never counts them (the same trick as
+    weighted_roc_auc_binary)."""
+    keep = w > 0
+    s_all = jnp.sort(jnp.where(keep, score, -jnp.inf))
+    s_pos = jnp.sort(jnp.where(keep & (y_true == 1), score, -jnp.inf))
+    n_total = score.shape[0]
+    n_below_all = jnp.searchsorted(s_all, score, side="left")
+    n_below_pos = jnp.searchsorted(s_pos, score, side="left")
+    n_ge = (n_total - n_below_all).astype(jnp.float32)   # kept rows >= s_i
+    tp_ge = (n_total - n_below_pos).astype(jnp.float32)  # kept pos >= s_i
+    prec = tp_ge / jnp.maximum(n_ge, 1.0)
+    pos_w = (keep & (y_true == 1)).astype(jnp.float32)
+    n_pos = jnp.sum(pos_w)
+    return jnp.sum(prec * pos_w) / jnp.maximum(n_pos, _EPS)
+
+
+def weighted_roc_auc_ovr(y_true, proba, w, n_classes):
+    """Multiclass one-vs-rest ROC-AUC, macro over classes with positive
+    support (sklearn's roc_auc_score(..., multi_class='ovr')). Each class's
+    binary AUC uses its probability column as the score."""
+    def one(c):
+        return weighted_roc_auc_binary(
+            (y_true == c).astype(jnp.int32), proba[:, c], w
+        )
+
+    aucs = jnp.stack([one(c) for c in range(n_classes)])
+    w32 = w.astype(jnp.float32)
+    support = jnp.stack([
+        jnp.sum((y_true == c).astype(jnp.float32) * w32)
+        for c in range(n_classes)
+    ])
+    present = (support > 0).astype(jnp.float32)
+    return jnp.sum(aucs * present) / jnp.maximum(jnp.sum(present), _EPS)
+
+
+def weighted_roc_auc_ovo(y_true, proba, w, n_classes):
+    """Multiclass one-vs-one ROC-AUC (sklearn multi_class='ovo', macro):
+    mean over unordered class pairs (a, b) of
+    [AUC(a as pos, score p_a, rows in {a,b}) + AUC(b as pos, p_b)] / 2.
+    Pairs where either class has no kept support are EXCLUDED from the
+    mean (the binary AUC there is a degenerate 0 that would corrupt the
+    score; sklearn raises — excluding mirrors the OVR absent-class mask)."""
+    w32 = w.astype(jnp.float32)
+    support = jnp.stack([
+        jnp.sum((y_true == c).astype(jnp.float32) * w32)
+        for c in range(n_classes)
+    ])
+
+    def pair(a, b):
+        in_pair = ((y_true == a) | (y_true == b)).astype(w.dtype) * w
+        auc_a = weighted_roc_auc_binary(
+            (y_true == a).astype(jnp.int32), proba[:, a], in_pair
+        )
+        auc_b = weighted_roc_auc_binary(
+            (y_true == b).astype(jnp.int32), proba[:, b], in_pair
+        )
+        return 0.5 * (auc_a + auc_b)
+
+    pairs = [(a, b) for a in range(n_classes) for b in range(a + 1, n_classes)]
+    vals = jnp.stack([pair(a, b) for a, b in pairs])
+    ok = jnp.stack([
+        (support[a] > 0) & (support[b] > 0) for a, b in pairs
+    ]).astype(jnp.float32)
+    return jnp.sum(vals * ok) / jnp.maximum(jnp.sum(ok), _EPS)
+
+
 def weighted_roc_auc_binary(y_true, margin, w):
     """Binary ROC-AUC from a continuous decision score, via the average-rank
     formula (ties counted half) — identical to sklearn's trapezoidal
@@ -159,6 +247,14 @@ _CLS_LABEL_SCORERS = {
 
 _CLS_MARGIN_SCORERS = {
     "roc_auc": weighted_roc_auc_binary,
+    "average_precision": weighted_average_precision,
+}
+
+#: scorers evaluated on the predicted class-probability matrix [n, k]
+_CLS_PROBA_SCORERS = {
+    "neg_log_loss": lambda y, p, w, k: -weighted_log_loss(y, p, w, k),
+    "roc_auc_ovr": weighted_roc_auc_ovr,
+    "roc_auc_ovo": weighted_roc_auc_ovo,
 }
 
 _REG_SCORERS = {
@@ -171,7 +267,9 @@ _REG_SCORERS = {
 }
 
 
-_BINARY_ONLY_SCORERS = frozenset({"f1", "precision", "recall", "roc_auc"})
+_BINARY_ONLY_SCORERS = frozenset(
+    {"f1", "precision", "recall", "roc_auc", "average_precision"}
+)
 
 
 def validate_scoring(scoring, task: str, n_classes: int = 0, kernel=None) -> None:
@@ -183,13 +281,21 @@ def validate_scoring(scoring, task: str, n_classes: int = 0, kernel=None) -> Non
     (margin scorers on kernels without a decision margin)."""
     if scoring is None:
         return
+    if callable(scoring) and not isinstance(scoring, str):
+        # callable scorers take the host-side fallback path (executor
+        # fits per fold on device, exports an sklearn estimator, calls
+        # the scorer on host) — nothing to validate here beyond arity
+        return
     if not isinstance(scoring, str):
         raise ValueError(
-            f"scoring must be a sklearn scorer name (got {type(scoring).__name__}); "
-            "callable scorers are not supported by the jitted evaluation path"
+            f"scoring must be a sklearn scorer name or a callable "
+            f"scorer(estimator, X, y) (got {type(scoring).__name__})"
         )
     if task == "classification":
-        known = set(_CLS_LABEL_SCORERS) | set(_CLS_MARGIN_SCORERS)
+        known = (
+            set(_CLS_LABEL_SCORERS) | set(_CLS_MARGIN_SCORERS)
+            | set(_CLS_PROBA_SCORERS)
+        )
     elif task == "regression":
         known = set(_REG_SCORERS)
     else:
@@ -213,10 +319,28 @@ def validate_scoring(scoring, task: str, n_classes: int = 0, kernel=None) -> Non
                 f"scoring={scoring!r} needs a decision margin, which the "
                 f"{kernel.name} kernel does not expose"
             )
+    if scoring in _CLS_PROBA_SCORERS and kernel is not None:
+        from ..models.base import ModelKernel
+
+        if type(kernel).predict_proba is ModelKernel.predict_proba:
+            raise ValueError(
+                f"scoring={scoring!r} needs class probabilities, which the "
+                f"{kernel.name} kernel does not expose"
+            )
 
 
 def scoring_needs_margin(scoring) -> bool:
-    return scoring in _CLS_MARGIN_SCORERS
+    return isinstance(scoring, str) and scoring in _CLS_MARGIN_SCORERS
+
+
+def scoring_needs_proba(scoring) -> bool:
+    return isinstance(scoring, str) and scoring in _CLS_PROBA_SCORERS
+
+
+def proba_score(scoring, y_true, proba, w, n_classes):
+    return _CLS_PROBA_SCORERS[scoring](
+        y_true, proba, w, max(int(n_classes), 2)
+    )
 
 
 def classification_score(scoring, y_true, y_pred, w, n_classes):

@@ -76,24 +76,30 @@ def _dot(a, b, dims, *, interpret: bool = False):
 def _epoch_kernel(
     x_ref, y_ref, w_ref, lr_ref, alpha_ref, t0_ref, *state,
     act: str, k: int, n_layers: int, classification: bool,
-    interpret: bool = False,
+    solver: str = "adam", momentum: float = 0.9, nesterov: bool = True,
+    track_loss: bool = False, interpret: bool = False,
 ):
-    """One grid step = one Adam minibatch update for k packed lanes.
+    """One grid step = one solver minibatch update for k packed lanes.
 
     ``state`` = (inputs..., outputs...): per layer, [k-block] slabs of
-    (pW, pB, mW, mB, vW, vB). Outputs are initialized from the inputs at
-    step 0 and updated in place; their blocks revisit (index maps ignore
-    the step axis) so they stay in VMEM until the lane group changes.
+    (pW, pB, mW, mB, vW, vB) for adam or (pW, pB, velW, velB) for sgd
+    (sklearn SGDOptimizer: velocity momentum, optionally Nesterov) —
+    plus, when ``track_loss``, one trailing [k, 8, 128] per-lane
+    epoch-loss accumulator slab (the adaptive-lr schedule's signal).
+    Outputs are initialized from the inputs at step 0 and updated in
+    place; their blocks revisit (index maps ignore the step axis) so they
+    stay in VMEM until the lane group changes.
 
     Biases are carried as [k, 8, out] slabs of 8 IDENTICAL sublane rows:
     Mosaic cannot relayout the [1, out] vectors a scalar bias row would
     produce ("non-singleton logical dimension is replicated" compile
     error), so bias broadcast/reduction ride two tiny ones-matmuls
     ([bs, 8] x [8, out] and [8, bs] x [bs, out]) that keep every
-    intermediate in a native 2-D layout. Elementwise Adam preserves the
+    intermediate in a native 2-D layout. Elementwise updates preserve the
     row-identical invariant.
     """
-    n_half = 6 * n_layers
+    per_layer = 6 if solver == "adam" else 4
+    n_half = per_layer * n_layers + (1 if track_loss else 0)
     ins, outs = state[:n_half], state[n_half:]
     step = pl.program_id(1)
     act_f, act_g = _act_and_grad(act)
@@ -121,7 +127,7 @@ def _epoch_kernel(
     lg = pl.program_id(0)
 
     def refs(li):
-        return outs[6 * li : 6 * (li + 1)]
+        return outs[per_layer * li : per_layer * (li + 1)]
 
     for i in range(k):
         # per-lane scalars/vectors via masked reduce (TPU block-shape rules
@@ -155,41 +161,71 @@ def _epoch_kernel(
         else:
             dz = (acts[-1] - yb) * (wb / bw)
 
-        # ---- backward + in-place Adam, last layer first ----
+        if track_loss:
+            # per-batch DATA loss (the adaptive schedule's improvement
+            # signal; the L2 term is added host-side per epoch)
+            if classification:
+                logp = jnp.log(jnp.maximum(p, 1e-12))
+                batch_loss = -jnp.sum(yb * logp * wb) / bw
+            else:
+                batch_loss = 0.5 * jnp.sum(
+                    (acts[-1] - yb) ** 2 * wb
+                ) / bw
+            loss_ref = outs[-1]
+            loss_ref[i] = loss_ref[i] + batch_loss
+
+        # ---- backward + in-place update, last layer first ----
         for li in range(n_layers - 1, -1, -1):
-            pW, pB, mW, mB, vW, vB = refs(li)
+            slabs = refs(li)
+            pW, pB = slabs[0], slabs[1]
             gW = _dot(acts[li], dz, ((0,), (0,)), interpret=interpret) + (alpha / bw) * pW[i]
             gB = _dot(ones_r, dz, ((1,), (0,)), interpret=interpret)
             if li > 0:
                 da = _dot(dz, pW[i], ((1,), (1,)), interpret=interpret)
                 dz = da * act_g(zs[li - 1], acts[li])
 
-            m = B1 * mW[i] + (1.0 - B1) * gW
-            v = B2 * vW[i] + (1.0 - B2) * gW * gW
-            mW[i], vW[i] = m, v
-            pW[i] = pW[i] - lr * (m / bc1) / (jnp.sqrt(v / bc2) + EPS)
+            if solver == "adam":
+                _, _, mW, mB, vW, vB = slabs
+                m = B1 * mW[i] + (1.0 - B1) * gW
+                v = B2 * vW[i] + (1.0 - B2) * gW * gW
+                mW[i], vW[i] = m, v
+                pW[i] = pW[i] - lr * (m / bc1) / (jnp.sqrt(v / bc2) + EPS)
 
-            mb = B1 * mB[i] + (1.0 - B1) * gB
-            vb = B2 * vB[i] + (1.0 - B2) * gB * gB
-            mB[i], vB[i] = mb, vb
-            pB[i] = pB[i] - lr * (mb / bc1) / (jnp.sqrt(vb / bc2) + EPS)
+                mb = B1 * mB[i] + (1.0 - B1) * gB
+                vb = B2 * vB[i] + (1.0 - B2) * gB * gB
+                mB[i], vB[i] = mb, vb
+                pB[i] = pB[i] - lr * (mb / bc1) / (jnp.sqrt(vb / bc2) + EPS)
+            else:  # sgd: sklearn velocity momentum (+ Nesterov look-ahead)
+                _, _, velW, velB = slabs
+                vw = momentum * velW[i] - lr * gW
+                vb = momentum * velB[i] - lr * gB
+                velW[i], velB[i] = vw, vb
+                if nesterov:
+                    pW[i] = pW[i] + momentum * vw - lr * gW
+                    pB[i] = pB[i] + momentum * vb - lr * gB
+                else:
+                    pW[i] = pW[i] + vw
+                    pB[i] = pB[i] + vb
 
 
-def vmem_lane_bytes(dims: Sequence[int], bs: int) -> int:
-    """Per-lane VMEM working set: 2x (in+out blocks) 3x f32 state plus the
+def vmem_lane_bytes(dims: Sequence[int], bs: int, solver: str = "adam") -> int:
+    """Per-lane VMEM working set: 2x (in+out blocks) state slabs (3x f32
+    for adam's params+moments, 2x for sgd's params+velocity) plus the
     step's live activations — the k-chooser's denominator."""
     params = sum(a * b + b for a, b in zip(dims[:-1], dims[1:]))
     acts = bs * (2 * sum(dims) + max(dims))
-    return 2 * 12 * params + 4 * acts
+    per_layer = 12 if solver == "adam" else 8
+    return 2 * per_layer * params + 4 * acts
 
 
-def pick_k(dims: Sequence[int], bs: int, budget_bytes: int = 48 * 2**20) -> int:
+def pick_k(dims: Sequence[int], bs: int, budget_bytes: int = 48 * 2**20,
+           solver: str = "adam") -> int:
     """Largest k in {8,4,2,1} whose packed state fits the VMEM budget.
 
     The budget tracks the raised per-kernel vmem limit (the pallas_call
     passes compiler_params vmem_limit_bytes=100 MB), less headroom for
     the double-buffered batch blocks."""
-    per = max(vmem_lane_bytes(dims, bs), 1)
+    per = max(vmem_lane_bytes(dims, bs, solver), 1)
     for k in (8, 4, 2, 1):
         if k * per <= budget_bytes:
             return k
@@ -204,6 +240,10 @@ def build_epoch_fn(
     n_lanes: int,
     k: int,
     classification: bool,
+    solver: str = "adam",
+    momentum: float = 0.9,
+    nesterov: bool = True,
+    track_loss: bool = False,
     interpret: bool = False,
 ):
     """fn(Xs, Ys, Wlane, lr, alpha, t0, state) -> state.
@@ -213,9 +253,11 @@ def build_epoch_fn(
     per-lane split weights in the same shuffled row order (lane-minor so
     batch-step blocks satisfy TPU block-shape rules); ``lr``/``alpha``
     [n_lanes, 1]; ``t0`` [1, 1] int32 (completed step count). ``state`` is
-    the flat per-layer list of [n_lanes, ...] (pW, pB, mW, mB, vW, vB);
-    biases are carried [n_lanes, 8, out] with identical sublane rows (see
-    the kernel docstring).
+    the flat per-layer list of [n_lanes, ...] — (pW, pB, mW, mB, vW, vB)
+    for adam, (pW, pB, velW, velB) for sgd — plus, when ``track_loss``, a
+    trailing [n_lanes, 8, 128] epoch-loss accumulator (zeroed at step 0,
+    read back at [:, 0, 0]); biases are carried [n_lanes, 8, out] with
+    identical sublane rows (see the kernel docstring).
     ``n_lanes`` must be a multiple of ``k``; ``bs`` a multiple of 8.
     """
     assert n_lanes % k == 0, (n_lanes, k)
@@ -230,7 +272,8 @@ def build_epoch_fn(
 
     kern = functools.partial(
         _epoch_kernel, act=act, k=k, n_layers=n_layers,
-        classification=classification, interpret=interpret,
+        classification=classification, solver=solver, momentum=momentum,
+        nesterov=nesterov, track_loss=track_loss, interpret=interpret,
     )
 
     def fn(Xs, Ys, Wlane, lr, alpha, t0, state):

@@ -603,6 +603,7 @@ def build_tree_deep(
     count_from_stats: bool = False,
     groups: Optional[Dict[str, jnp.ndarray]] = None,
     w_schedule: Optional[Tuple[int, int, int]] = None,
+    nb_schedule: Optional[Tuple[int, int]] = None,
 ) -> Dict[str, jnp.ndarray]:
     """Deep tree via frontier-compacted level-wise growth (batched best-first).
 
@@ -636,6 +637,19 @@ def build_tree_deep(
     dict carries {"xb_cont" [n, dc], "xb_coarse" [n, db], "fid_cont" [dc],
     "fid_coarse" [db]}; split records stay in GLOBAL feature ids, so
     routing, prediction, and artifacts are unchanged.
+
+    ``nb_schedule`` (occ_w, nb_deep): ADAPTIVE bin resolution by frontier
+    occupancy. Split resolution matters most while nodes are big (early,
+    narrow frontier) and the histogram conv's cost is linear in bins x
+    frontier width (the per-level MXU term profiled as >50% of a deep
+    level) — so candidate evaluation runs at the full ``n_bins`` while the
+    candidate frontier is <= occ_w nodes, and at the 2^s-fold coarser
+    ``nb_deep`` beyond. Coarse candidates are formed by summing ADJACENT
+    fine histogram bins, so the coarse candidate set is an exact subset of
+    the fine threshold set: split records stay in FINE bin units (the last
+    fine code of the chosen coarse bin) and routing/prediction/artifacts
+    are untouched. Resolution is monotone non-increasing over levels (a
+    width-schedule drop never re-raises it).
 
     Shapes are static: the frontier width at level l is min(2^l, width)
     (early levels don't pay the full budget), the arena is a fixed
@@ -690,12 +704,49 @@ def build_tree_deep(
     else:
         gspec = ((xb, None, n_bins),)
 
-    def hist_groups(local, m):
+    # adaptive bin resolution (docstring): r(level) = n_bins while the
+    # candidate frontier is narrow, nb_deep once wide; monotone. Applies
+    # to groups histogrammed at the full n_bins (the continuous/single
+    # group) — the COARSE_BINS group is already minimal.
+    nbsched = os.environ.get("CS230_DEEP_NBSCHED", "")
+    if nbsched:
+        occ_w, nb_deep = (int(x) for x in nbsched.split(":"))
+        nb_schedule = (occ_w, nb_deep)
+    if nb_schedule is not None:
+        occ_w, nb_deep = (int(x) for x in nb_schedule)
+        if nb_deep <= 0 or n_bins % max(nb_deep, 1) or nb_deep > n_bins:
+            raise ValueError(
+                f"nb_schedule deep bins {nb_deep} must divide n_bins {n_bins}"
+            )
+    else:
+        occ_w, nb_deep = 0, n_bins
+
+    def res_at(cand_w: int) -> int:
+        # strict <: a band whose saturated candidate frontier equals occ_w
+        # (2 x its width cap) must go coarse AT saturation, not stay fine
+        return n_bins if (occ_w <= 0 or cand_w < occ_w) else nb_deep
+
+    def g_res(r: int, nbg: int) -> int:
+        # per-group resolution: only full-resolution groups follow r
+        return r if nbg == n_bins else nbg
+
+    def coarsen(H, r_from: int, r_to: int):
+        if r_from == r_to:
+            return H
+        m, dg, _, kkp = H.shape
+        return H.reshape(m, dg, r_to, r_from // r_to, kkp).sum(3)
+
+    def hist_groups(local, m, r):
+        xgs = tuple(
+            xg if g_res(r, nbg) == nbg else xg // (nbg // r)
+            for xg, _, nbg in gspec
+        )
+        nbs = tuple(g_res(r, nbg) for _, _, nbg in gspec)
         if len(gspec) == 1:
             # single group: keep the compact-histogram opt-in gate reachable
             # (_use_compact routes wide frontiers when CS230_HIST_COMPACT=1)
             return (_hist_with_count(
-                local, gspec[0][0], SC, m, gspec[0][2], precision, k,
+                local, xgs[0], SC, m, nbs[0], precision, k,
                 count_from_stats,
             ),)
         # ONE row scan for all groups: the dominant [row_chunk, m*kk]
@@ -703,23 +754,28 @@ def build_tree_deep(
         # group's bin one-hot (see _level_histogram_multi)
         return _hist_with_count_multi(
             local,
-            tuple(xg for xg, _, _ in gspec),
+            xgs,
             SC, m,
-            tuple(nbg for _, _, nbg in gspec),
+            nbs,
             precision, k, count_from_stats,
         )
 
-    def best_from_hists(Hs, node_ids):
-        """Per-node best (gain, GLOBAL feature, bin) across groups; ties
-        keep the earlier group (continuous first)."""
+    def best_from_hists(Hs, node_ids, r):
+        """Per-node best (gain, GLOBAL feature, FINE bin) across groups;
+        ties keep the earlier group (continuous first)."""
         allowed = _feature_subset_allowed(node_ids, key, max_features, d)
         best = None
         for Hg, (_, fidg, nbg) in zip(Hs, gspec):
-            g = _split_gain(Hg, k, nbg, min_samples_leaf)
+            rg = g_res(r, nbg)
+            g = _split_gain(Hg, k, rg, min_samples_leaf)
             if allowed is not None:
                 ag = allowed if fidg is None else jnp.take(allowed, fidg, axis=1)
                 g = jnp.where(ag[:, :, None], g, -jnp.inf)
-            bg, bfl, bbl = _pick_best(g, nbg)
+            bg, bfl, bbl = _pick_best(g, rg)
+            if rg != nbg:
+                # coarse candidate b covers fine codes [b*ratio, (b+1)*ratio)
+                # -> the equivalent FINE threshold is its last code
+                bbl = (bbl + 1) * (nbg // rg) - 1
             bfg = bfl if fidg is None else jnp.take(fidg, bfl).astype(jnp.int32)
             if best is None:
                 best = (bg, bfg, bbl)
@@ -734,8 +790,9 @@ def build_tree_deep(
 
     # root: full histogram + its best split
     frontier = jnp.zeros((1,), jnp.int32)
-    H = hist_groups(node, 1)
-    gain, bf, bb = best_from_hists(H, frontier)
+    r_H = res_at(2)
+    H = hist_groups(node, 1, r_H)
+    gain, bf, bb = best_from_hists(H, frontier, r_H)
 
     for level in range(levels):
         W_l = frontier.shape[0]
@@ -791,7 +848,16 @@ def build_tree_deep(
         # children's histograms: left by matmul over parent slots, right by
         # subtraction (exact for integer stats; float tails are gain-clamped)
         local_left = jnp.where(in_split & go_left, slot, W_l)
-        H_L = hist_groups(local_left, W_l)
+        # candidate resolution for this level's 2*W_l children (monotone
+        # non-increasing); parents coarsen by adjacent-bin sums — exact
+        r_c = min(r_H, res_at(2 * W_l))
+        if r_c != r_H:
+            H = tuple(
+                coarsen(h, g_res(r_H, nbg), g_res(r_c, nbg))
+                for h, (_, _, nbg) in zip(H, gspec)
+            )
+            r_H = r_c
+        H_L = hist_groups(local_left, W_l, r_c)
         cand_H = tuple(
             jnp.concatenate([hl, h - hl], axis=0)  # [2*W_l, d_g, nb_g, k+1]
             for h, hl in zip(H, H_L)
@@ -799,7 +865,7 @@ def build_tree_deep(
         cand_id = jnp.concatenate(
             [jnp.where(do_split, left_id, -1), jnp.where(do_split, left_id + 1, -1)]
         )
-        cgain, cbf, cbb = best_from_hists(cand_H, cand_id)
+        cgain, cbf, cbb = best_from_hists(cand_H, cand_id, r_c)
         cgain = jnp.where(cand_id >= 0, cgain, -jnp.inf)
 
         W_next = min(2 * W_l, width_at(level + 1))

@@ -574,11 +574,13 @@ def fit_single(
     data: TrialData,
     plan: SplitPlan,
     params: Dict[str, Any],
+    split: int = 0,
 ):
-    """Fit one configuration on the holdout-train split and return the fitted
-    params pytree (host numpy). Used to materialize the best model artifact
-    after aggregation (reference pickles every trial's model,
-    worker.py:352-356; we refit only the winner)."""
+    """Fit one configuration on one split's train subset (default: the
+    holdout-train split) and return the fitted params pytree (host numpy).
+    Used to materialize the best model artifact after aggregation
+    (reference pickles every trial's model, worker.py:352-356; we refit
+    only the winner), and per CV fold by the callable-scoring fallback."""
     n, d = data.X.shape
     static_key, hyper = kernel.canonicalize(params)
     static = kernel.static_from_key(static_key)
@@ -593,7 +595,7 @@ def fit_single(
     else:
         X = jnp.asarray(data.X, jnp.float32)
     y = jnp.asarray(data.y)
-    w = jnp.asarray(plan.train_w[0])
+    w = jnp.asarray(plan.train_w[split])
     hyper_arg = {k: jnp.asarray(v, jnp.float32) for k, v in hyper.items()}
     fit_key = (
         "fit_single",
@@ -644,6 +646,65 @@ def fit_single(
         )
     fitted = _compiled_cache[fit_key](X, y, w, hyper_arg)
     return jax.tree_util.tree_map(np.asarray, fitted), static
+
+
+def run_trials_callable(
+    kernel: ModelKernel,
+    data: TrialData,
+    plan: SplitPlan,
+    params_list: Sequence[Dict[str, Any]],
+    scorer,
+) -> List[Dict[str, Any]]:
+    """Host-side fallback for CALLABLE ``scoring``: per (trial, fold) the
+    kernel fits on device (fit_single — jit-cached per static bucket, so
+    the accelerated fit is kept), the fitted params are exported to a real
+    sklearn estimator (runtime/sklearn_export), and the user's
+    ``scorer(estimator, X_eval, y_eval)`` runs on host. Slower than the
+    jitted scorer registry (one export + one host call per fold) but
+    correct for ANY sklearn-scorer callable — the reference client passed
+    arbitrary ``scoring`` through and its worker silently dropped it
+    (DistributedLibrary core.py:135-138, worker.py:320-349); here it ranks
+    trials. Returns per-trial metrics dicts shaped like _postprocess's."""
+    from ..runtime.sklearn_export import to_sklearn
+
+    X_np = np.asarray(data.X)
+    y_np = np.asarray(data.y)
+    results: List[Dict[str, Any]] = []
+    for params in params_list:
+        split_scores: List[float] = []
+        scorer_errors: List[str] = []
+        for s in range(plan.n_splits):
+            fitted, static = fit_single(kernel, data, plan, params, split=s)
+            est = to_sklearn({
+                "model_type": kernel.name,
+                "parameters": params,
+                "static": dict(static),
+                "fitted_params": fitted,
+            })
+            keep = np.asarray(plan.eval_w[s]) > 0
+            try:
+                split_scores.append(float(scorer(est, X_np[keep], y_np[keep])))
+            except Exception as e:  # noqa: BLE001 — a scorer bug fails THIS
+                # trial (ranked last), not the whole job
+                split_scores.append(float("nan"))
+                scorer_errors.append(f"split {s}: {e!r}")
+        metrics: Dict[str, Any] = {"scoring": "callable",
+                                   "score": split_scores[0]}
+        if plan.n_folds >= 2 and len(split_scores) > 1:
+            metrics["cv_scores"] = split_scores[1:]
+            metrics["mean_cv_score"] = float(np.mean(split_scores[1:]))
+        else:
+            metrics["mean_cv_score"] = split_scores[0]
+        # ANY non-finite split (a holdout-only scorer failure included)
+        # marks the trial diverged — a silently-NaN holdout score with a
+        # finite CV mean would hide the error entirely
+        if not all(np.isfinite(v) for v in split_scores):
+            metrics["mean_cv_score"] = float("-inf")
+            metrics["diverged"] = True
+            if scorer_errors:
+                metrics["scorer_error"] = "; ".join(scorer_errors)
+        results.append(metrics)
+    return results
 
 
 def _chunk_best(mesh, trial_axis: str, chunk: int, n_splits: int, n_folds: int):

@@ -252,6 +252,74 @@ def _prefetch_agree(executor, tasks) -> List[str]:
     return bad
 
 
+def _slice_watchdog(url: str, slice_id: str, rank: int, n_proc: int) -> None:
+    """Per-rank SPMD slice liveness (daemon thread on EVERY rank).
+
+    A SIGKILLed sibling leaves survivors blocked inside a collective —
+    process 0's REST worker heartbeats are a separate daemon thread that
+    KEEPS running, so the coordinator would never mark the slice dead and
+    its pulled tasks would hang forever. Each rank therefore heartbeats
+    ``POST /slice_heartbeat/<slice>/<rank>`` and checks the siblings' ages;
+    a sibling stale past the scheduler's ``dead_after_s`` (or absent after
+    a startup grace) kills THIS rank too (non-zero exit) — process 0's
+    death stops the worker heartbeats, the coordinator's dead-worker sweep
+    requeues the pulled tasks onto surviving workers, and the restart
+    policy relaunches the whole slice (one jax.distributed runtime cannot
+    be rejoined by a lone respawned rank; see run_distributed docstring).
+    Reference analog: dead-worker requeue, scheduler_service.py:218-247 —
+    extended to the fleet mode where the workers ARE one SPMD program."""
+    import requests
+
+    cfg = get_config().scheduler
+    interval = cfg.heartbeat_interval_s
+    dead_after = max(cfg.dead_after_s, 2 * interval)
+    grace_until = time.time() + 6 * dead_after
+    # a sibling ABSENT from the table (vs stale) must persist missing for
+    # dead_after before it counts as dead: a coordinator restart wipes the
+    # in-memory slice table, and killing every healthy rank of every slice
+    # over a routine coordinator bounce would turn one restart into a
+    # fleet-wide requeue storm
+    missing_since: Dict[int, float] = {}
+    while True:
+        try:
+            requests.post(
+                f"{url}/slice_heartbeat/{slice_id}/{rank}", timeout=10
+            )
+            resp = requests.get(f"{url}/slice_status/{slice_id}", timeout=10)
+            ages = {
+                int(r): float(a)
+                for r, a in resp.json().get("ranks", {}).items()
+            }
+        except Exception:  # noqa: BLE001 — coordinator unreachable: the
+            # generic worker-heartbeat path owns that failure mode
+            time.sleep(interval)
+            continue
+        now = time.time()
+        for sib in range(n_proc):
+            if sib == rank:
+                continue
+            age = ages.get(sib)
+            if age is None:
+                if now <= grace_until:
+                    continue
+                first = missing_since.setdefault(sib, now)
+                if now - first <= dead_after:
+                    continue
+            else:
+                missing_since.pop(sib, None)
+                if age <= dead_after:
+                    continue
+            logger.error(
+                "SPMD slice %s: rank %d lost sibling rank %d "
+                "(age %s, threshold %.1fs); exiting for slice restart",
+                slice_id, rank, sib, age, dead_after,
+            )
+            import os
+
+            os._exit(DEVICE_LOST_EXIT_CODE)
+        time.sleep(interval)
+
+
 def run_distributed(
     url: str,
     *,
@@ -292,6 +360,22 @@ def run_distributed(
         jax.process_index(), n_proc, len(jax.devices()),
         len(jax.local_devices()),
     )
+
+    if n_proc > 1:
+        # slice id agreed via one host-level broadcast, then every rank
+        # watches its siblings through the coordinator (slice watchdog:
+        # a dead rank must take the slice down so pulled tasks requeue)
+        import uuid
+
+        sid_msg = broadcast_json(
+            {"slice_id": uuid.uuid4().hex[:12]} if is_primary() else None
+        )
+        threading.Thread(
+            target=_slice_watchdog,
+            args=(url.rstrip("/"), sid_msg["slice_id"],
+                  jax.process_index(), n_proc),
+            daemon=True,
+        ).start()
 
     agent: Optional[WorkerAgent] = None
     if is_primary():

@@ -171,6 +171,21 @@ class Coordinator:
         cv_params = model_details.get("cv_params") or {}
         if "cv" in cv_params and "cv" not in train_params:
             train_params["cv"] = cv_params["cv"]
+        scoring = train_params.get("scoring", cv_params.get("scoring"))
+        if (
+            callable(scoring) and not isinstance(scoring, str)
+            and self.cluster is not None
+        ):
+            # a cluster's remote agents pull tasks over REST, where
+            # json_safe would stringify the function into a confusing
+            # "unsupported scoring '<function ...>'" server error per
+            # trial; fail the submission with the real reason instead
+            # (the default in-process executor honors callables)
+            raise ValueError(
+                "callable scoring is not supported on a clustered "
+                "coordinator (tasks are serialized to worker agents); "
+                "use a scorer name, or a coordinator without a cluster"
+            )
 
         subtasks = create_subtasks(job_id, sid, dataset_id, model_details, train_params)
         try:

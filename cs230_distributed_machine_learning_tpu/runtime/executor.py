@@ -171,16 +171,37 @@ class LocalExecutor:
                 started_at = time.time()
                 profiler_cm = self._profiler_cm(model_type)
                 with profiler_cm, ResourceSampler() as sampler:
-                    run = run_trials(
-                        kernel,
-                        data,
-                        plan,
-                        [subtasks[i]["parameters"] for i in idxs],
-                        mesh=self.mesh,
-                        trial_axis=self.trial_axis,
-                        max_trials_per_batch=self.max_trials_per_batch,
-                        scoring=scoring,
-                    )
+                    if callable(scoring) and not isinstance(scoring, str):
+                        # host-side fallback: device fits per fold, sklearn
+                        # export, user scorer on host (trial_map docstring)
+                        from ..parallel.trial_map import (
+                            TrialRunResult,
+                            run_trials_callable,
+                        )
+
+                        t0 = time.time()
+                        metrics_list = run_trials_callable(
+                            kernel, data, plan,
+                            [subtasks[i]["parameters"] for i in idxs],
+                            scoring,
+                        )
+                        run = TrialRunResult(
+                            trial_metrics=metrics_list,
+                            compile_time_s=0.0,
+                            run_time_s=time.time() - t0,
+                            n_dispatches=len(idxs) * plan.n_splits,
+                        )
+                    else:
+                        run = run_trials(
+                            kernel,
+                            data,
+                            plan,
+                            [subtasks[i]["parameters"] for i in idxs],
+                            mesh=self.mesh,
+                            trial_axis=self.trial_axis,
+                            max_trials_per_batch=self.max_trials_per_batch,
+                            scoring=scoring,
+                        )
                 finished_at = time.time()
                 resources = sampler.averages()
                 per_trial_time = run.run_time_s / max(len(idxs), 1)
@@ -329,6 +350,23 @@ def _is_device_fatal(e: BaseException) -> bool:
     # a backend that never came up (e.g. two processes contending for one
     # chip) fails every batch this process will ever run — process-fatal
     if "Unable to initialize backend" in msg:
+        return True
+    # cross-process collective failure (a slice sibling died mid-program:
+    # gloo on CPU fleets, ICI/barrier errors on TPU slices): every later
+    # sharded dispatch on this rank fails too, and publishing per-task
+    # FAILED results would make the sibling's crash terminal for the job —
+    # escalate so the tasks stay queued for the dead-worker requeue
+    # (tests/test_chaos_spmd.py pins this path)
+    if ("JaxRuntimeError" in msg or "XlaRuntimeError" in msg) and any(
+        m in msg
+        for m in (
+            "Gloo ",
+            "Connection reset by peer",
+            "Connection closed by peer",
+            "coordination service",
+            "heartbeat",
+        )
+    ):
         return True
     if "XlaRuntimeError" not in msg and "DeviceLost" not in msg:
         return False

@@ -131,8 +131,22 @@ def create_app(coordinator: Optional[Coordinator] = None):
             # dataset distribution for remote agents: the DCN replacement
             # for the reference's shared EFS volume (compose.yml:92-94)
             Rule("/dataset/<dataset_id>", endpoint="dataset", methods=["GET"]),
+            # SPMD slice liveness: every rank of a multi-process mesh
+            # heartbeats here, and each rank's watchdog reads the others'
+            # ages — a SIGKILLed sibling is detected even while survivors
+            # block inside a collective (runtime/agent._slice_watchdog)
+            Rule("/slice_heartbeat/<slice_id>/<int:rank>",
+                 endpoint="slice_heartbeat", methods=["POST"]),
+            Rule("/slice_status/<slice_id>", endpoint="slice_status",
+                 methods=["GET"]),
         ]
     )
+
+    import threading as _threading
+    import time as _time
+
+    _slices: dict = {}
+    _slices_lock = _threading.Lock()
 
     def _json(data, status=200):
         return Response(
@@ -312,6 +326,29 @@ def create_app(coordinator: Optional[Coordinator] = None):
                 "Content-Disposition": f"attachment; filename={dataset_id}.csv",
             },
         )
+
+    def slice_heartbeat(request, slice_id, rank):
+        now = _time.time()
+        with _slices_lock:
+            _slices.setdefault(slice_id, {})[int(rank)] = now
+            # prune slices whose every rank went silent (crash-looping
+            # slices mint a fresh uuid per restart — without a sweep the
+            # table grows one dead dict per restart forever)
+            for sid in [
+                s for s, ranks in _slices.items()
+                if s != slice_id and ranks
+                and now - max(ranks.values()) > 900
+            ]:
+                del _slices[sid]
+        return _json({"status": "ok"})
+
+    def slice_status(request, slice_id):
+        now = _time.time()
+        with _slices_lock:
+            ranks = dict(_slices.get(slice_id, {}))
+        return _json({
+            "ranks": {str(r): round(now - ts, 3) for r, ts in ranks.items()}
+        })
 
     handlers = locals()
 

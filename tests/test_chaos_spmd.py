@@ -1,0 +1,239 @@
+"""SPMD-mesh host-loss chaos: kill one rank of a multi-process slice
+mid-job and the job still completes with the right winner.
+
+VERDICT r4 missing #3: task-parallel chaos was proven (tests/test_chaos.py)
+but losing a HOST of a pod-slice SPMD mesh (parallel/distributed.py +
+runtime/agent.run_distributed fleet mode) had no recovery test. The
+recovery chain under test:
+
+1. rank 1 of a 2-process mesh is SIGKILLed mid-job;
+2. every surviving rank's slice watchdog (runtime/agent._slice_watchdog)
+   notices the stale sibling through the coordinator's /slice_status and
+   exits non-zero — crucially including rank 0, whose REST worker
+   heartbeats would otherwise keep the dead slice looking alive forever;
+3. the coordinator's dead-worker sweep requeues the slice's pulled tasks
+   (reference analog: scheduler_service.py:218-247);
+4. a fallback single-process agent completes the job, and best_params_
+   matches a clean single-worker run of the same search (results are
+   deterministic in (dataset, params), not in which worker computed them).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SERVER_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.server import serve
+import sys
+serve(Coordinator(cluster=ClusterRuntime()), host="127.0.0.1", port=int(sys.argv[1]))
+"""
+
+AGENT_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from cs230_distributed_machine_learning_tpu.runtime.agent import WorkerAgent
+agent = WorkerAgent(sys.argv[1], poll_timeout_s=0.5, register_backoff_s=0.5)
+agent.run_forever()
+"""
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait_http(url, timeout=60, proc=None):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc is not None and proc.poll() is not None:
+            return False
+        try:
+            with urllib.request.urlopen(url, timeout=2):
+                return True
+        except Exception:  # noqa: BLE001
+            time.sleep(0.3)
+    return False
+
+
+def test_spmd_host_loss_requeues_onto_survivor(tmp_path):
+    port = _free_port()
+    jd_port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env["TPUML_PLATFORM"] = "cpu"
+    # fast failure detection: 1 s heartbeats, dead after 3 s, 1 s sweeps
+    env["TPUML_SCHEDULER__HEARTBEAT_INTERVAL_S"] = "1.0"
+    env["TPUML_SCHEDULER__DEAD_AFTER_S"] = "3.0"
+    env["TPUML_SCHEDULER__SWEEP_INTERVAL_S"] = "1.0"
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+
+    logs = {}
+    procs = {}
+
+    def _tail(name):
+        f = logs[name]
+        f.flush()
+        f.seek(0)
+        return f"--- {name}:\n" + f.read()[-3000:]
+
+    def _spawn(name, cmd):
+        logs[name] = open(tmp_path / f"{name}.log", "w+")
+        procs[name] = subprocess.Popen(
+            cmd, env=env, cwd=REPO,
+            stdout=logs[name], stderr=subprocess.STDOUT,
+        )
+        return procs[name]
+
+    try:
+        server = _spawn(
+            "server", [sys.executable, "-c", SERVER_SCRIPT, str(port)]
+        )
+        assert _wait_http(f"{url}/health", proc=server), _tail("server")
+
+        for rank in (0, 1):
+            _spawn(
+                f"rank{rank}",
+                [
+                    sys.executable, "-m",
+                    "cs230_distributed_machine_learning_tpu.runtime.agent",
+                    "--url", url,
+                    "--distributed",
+                    "--coordinator-address", f"127.0.0.1:{jd_port}",
+                    "--num-processes", "2",
+                    "--process-id", str(rank),
+                    "--local-devices", "2",
+                    # small batches: the job spans several polls so the
+                    # kill lands mid-job with work still queued
+                    "--max-batch", "2",
+                ],
+            )
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            for name, p in procs.items():
+                if p.poll() is not None:
+                    pytest.fail(f"{name} died early:\n{_tail(name)}")
+            try:
+                with urllib.request.urlopen(f"{url}/workers", timeout=5) as r:
+                    if json.load(r):
+                        break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        else:
+            pytest.fail(_tail("rank0") + _tail("rank1"))
+
+        from sklearn.linear_model import LogisticRegression
+        from sklearn.model_selection import GridSearchCV
+
+        from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+        grid = {"C": [0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0],
+                "tol": [1e-4, 1e-3]}  # 16 trials over >= 8 polls at max=2
+
+        m = MLTaskManager(url=url)
+        status_box = {}
+
+        def _run_job():
+            status_box["status"] = m.train(
+                GridSearchCV(LogisticRegression(max_iter=300), grid, cv=3),
+                "iris",
+                show_progress=False,
+                timeout=480,
+            )
+
+        t = threading.Thread(target=_run_job, daemon=True)
+        t.start()
+
+        # wait until the slice has posted SOME results (mid-job), then
+        # SIGKILL rank 1
+        deadline = time.time() + 180
+        killed = False
+        while time.time() < deadline and not killed:
+            try:
+                with urllib.request.urlopen(f"{url}/jobs", timeout=5) as r:
+                    jobs = json.load(r)
+                for j in jobs:
+                    done = j.get("completed_subtasks") or 0
+                    total = j.get("total_subtasks") or 99
+                    if 0 < done < total:
+                        procs["rank1"].send_signal(signal.SIGKILL)
+                        killed = True
+                        break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.3)
+        assert killed, (
+            "job never reached a mid-flight state:\n" + _tail("rank0")
+        )
+
+        # the watchdog must take rank 0 down too (exit code 13) — without
+        # it the dead slice would heartbeat forever and the job would hang
+        deadline = time.time() + 90
+        while time.time() < deadline and procs["rank0"].poll() is None:
+            time.sleep(0.5)
+        assert procs["rank0"].poll() is not None, (
+            "rank0 survived sibling loss — slice watchdog failed:\n"
+            + _tail("rank0")
+        )
+
+        # fallback worker joins; dead-worker sweep requeues; job completes
+        _spawn("fallback", [sys.executable, "-c", AGENT_SCRIPT, url])
+        t.join(timeout=420)
+        assert not t.is_alive(), (
+            "job did not finish after failover:\n" + _tail("server")
+            + _tail("fallback")
+        )
+        status = status_box["status"]
+        assert status["job_status"] == "completed", status
+        result = status["job_result"]
+        assert len(result["results"]) == 16 and not result.get("failed"), (
+            result, _tail("fallback")
+        )
+
+        # winner parity vs a clean single-worker run of the same search
+        m2 = MLTaskManager(url=url)
+        clean = m2.train(
+            GridSearchCV(LogisticRegression(max_iter=300), grid, cv=3),
+            "iris",
+            show_progress=False,
+            timeout=480,
+        )
+        assert clean["job_status"] == "completed"
+        assert (
+            result["best_result"]["parameters"]
+            == clean["job_result"]["best_result"]["parameters"]
+        ), (result["best_result"], clean["job_result"]["best_result"])
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs.values():
+            f.close()

@@ -101,9 +101,57 @@ def test_regressor_matches_generic():
         assert np.max(np.abs(np.asarray(gen[key]) - np.asarray(fus[key]))) < 0.02
 
 
+def test_sgd_fused_matches_generic():
+    """r5: the fused path covers solver='sgd' (velocity momentum +
+    Nesterov) — previously an automatic fallback to the generic engine."""
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=512, n_features=20, n_informative=10, n_classes=3,
+        random_state=2,
+    )
+    for extra in (
+        {},  # nesterov momentum (sklearn default)
+        {"nesterovs_momentum": False},
+        {"momentum": 0.5},
+        {"learning_rate": "invscaling", "power_t": 0.4},
+        {"learning_rate": "adaptive", "n_iter_no_change": 2, "tol": 1e-2},
+    ):
+        gen, fus = _scores(
+            "MLPClassifier", X.astype(np.float32), y.astype(np.int32),
+            [{"hidden_layer_sizes": (32,), "max_iter": 15, "batch_size": 64,
+              "random_state": 0, "solver": "sgd",
+              "learning_rate_init": 0.05, **extra}],
+            3, "classification",
+        )
+        assert np.max(
+            np.abs(np.asarray(gen["score"]) - np.asarray(fus["score"]))
+        ) < 0.03, extra
+
+
+def test_ragged_batch_size_fused_matches_generic():
+    """r5: non-8-multiple batch sizes pad each batch block with
+    zero-weight slots — previously an automatic fallback."""
+    from sklearn.datasets import make_classification
+
+    X, y = make_classification(
+        n_samples=500, n_features=16, n_informative=8, n_classes=3,
+        random_state=3,
+    )
+    gen, fus = _scores(
+        "MLPClassifier", X.astype(np.float32), y.astype(np.int32),
+        [{"hidden_layer_sizes": (24,), "max_iter": 15, "batch_size": 50,
+          "random_state": 0}],
+        3, "classification",
+    )
+    assert np.max(
+        np.abs(np.asarray(gen["score"]) - np.asarray(fus["score"]))
+    ) < 0.02
+
+
 def test_inapplicable_configs_fall_back():
-    """sgd solver / non-multiple-of-8 batch / adaptive lr must return None
-    (the engine then uses the generic vmapped path)."""
+    """Configs the kernel cannot honor must return None (the engine then
+    uses the generic vmapped path)."""
     kernel = get_kernel("MLPClassifier")
 
     def static_for(extra):
@@ -114,18 +162,14 @@ def test_inapplicable_configs_fall_back():
         st["_n_classes"] = 2
         return st
 
-    assert kernel.build_batched_fn(static_for({"solver": "sgd"}), 256, 8, 2, 3, 1) is None
-    assert kernel.build_batched_fn(static_for({"batch_size": 50}), 256, 8, 2, 3, 1) is None
-    assert (
-        kernel.build_batched_fn(
-            static_for({"learning_rate": "adaptive"}), 256, 8, 2, 3, 1
-        )
-        is None
-    )
     # non-default Adam constants: the kernel hardcodes sklearn's, so these
     # must fall back to the generic path that honors them
     assert kernel.build_batched_fn(static_for({"epsilon": 1e-4}), 256, 8, 2, 3, 1) is None
     assert kernel.build_batched_fn(static_for({"beta_1": 0.8}), 256, 8, 2, 3, 1) is None
+    assert kernel.build_batched_fn(static_for({"shuffle": False}), 256, 8, 2, 3, 1) is None
+    assert kernel.build_batched_fn(
+        static_for({"hidden_layer_sizes": (8, 8, 8, 8)}), 256, 8, 2, 3, 1
+    ) is None
 
 
 def test_pick_k_respects_vmem_budget():

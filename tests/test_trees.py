@@ -35,6 +35,32 @@ def test_random_forest_classifier_parity(iris_data):
     assert m["accuracy"] > 0.9
 
 
+def test_tiny_forest_predict_smaller_than_group(iris_data):
+    """n_estimators below the tree-group batch size must predict without
+    shape errors (wrap-around padding in _forest_leaf_mean; the truncating
+    pad crashed reshape when pad > n_trees)."""
+    data, plan, X, y = iris_data
+    kernel = get_kernel("RandomForestClassifier")
+    out = run_trials(kernel, data, plan, [{"n_estimators": 2, "random_state": 0}])
+    assert out.trial_metrics[0]["accuracy"] > 0.7
+
+    from cs230_distributed_machine_learning_tpu.parallel.trial_map import (
+        fit_single,
+    )
+
+    fitted, static = fit_single(
+        kernel, data, plan, {"n_estimators": 2, "random_state": 0}
+    )
+    import jax.numpy as jnp
+
+    from cs230_distributed_machine_learning_tpu.runtime.artifacts import (
+        jnp_tree,
+    )
+
+    pred = kernel.predict(jnp_tree(fitted), jnp.asarray(X, jnp.float32), static)
+    assert pred.shape == (X.shape[0],)
+
+
 def test_gradient_boosting_classifier_parity(iris_data):
     from sklearn.ensemble import GradientBoostingClassifier
     from sklearn.model_selection import cross_val_score
