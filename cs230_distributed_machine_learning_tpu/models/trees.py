@@ -86,11 +86,15 @@ _DEEP_LEVELS_EXPLICIT = 32
 #   W=1536 nb=16 cv 0.8366 (286.7 s)   W=2048 nb=12 cv 0.8365 (saturates)
 # The top width band therefore pairs W=1024 with 24 bins; the narrower
 # bands keep the 48-bin cap their parity anchors were measured at.
-_DEEP_W = int(os.environ.get("CS230_DEEP_W", "1024"))
+_DEEP_W = int(os.environ.get("CS230_DEEP_W", "1536"))
 _DEEP_BINS_CAP = int(os.environ.get("CS230_DEEP_BINS", "48"))
-#: bins cap when the TOP width band is in play (n > 49152): the measured
-#: constant-cost width/bins trade above
+#: bins cap when the TOP width bands are in play (n > 49152): the measured
+#: constant-cost width/bins trade above. _DEEP_BINS_WIDEST applies at the
+#: 1536-wide band (n > 80k), where the r5 Pareto sweep landed on
+#: (1536, 17, 512) + adaptive 48/16: CV 0.8368 (-0.0027 vs sklearn) at
+#: 200.4 s = 2.42x — the first default inside BOTH r4 #4 bars.
 _DEEP_BINS_WIDE = int(os.environ.get("CS230_DEEP_BINS_WIDE", "24"))
+_DEEP_BINS_WIDEST = int(os.environ.get("CS230_DEEP_BINS_WIDEST", "16"))
 #: r5 adaptive bin resolution (ops/trees.build_tree_deep nb_schedule):
 #: candidate evaluation runs at the full (fine) binning while the
 #: candidate frontier has <= _DEEP_BINS_OCC nodes — early splits on BIG
@@ -221,14 +225,14 @@ class _TreeBase(ModelKernel):
             else:
                 levels = min(int(depth), _DEEP_LEVELS_EXPLICIT)
             # Width by explicit monotone bands anchored at on-device
-            # parity measurements (Covertype RF-100, CV delta vs sklearn
-            # in parens): 5.8k->128 (+0.003), 11.6k->128 (-0.006, 10.6 s
-            # = 3.0x sklearn), 29k->256 (-0.007), 58k/116k->1024@24bins
-            # (-0.0072 at 116k vs the honest 0.8400 denominator, 231.9 s
-            # steady — the r4 width/bins trade, sweep table at _DEEP_W;
-            # the 58k row BEATS sklearn: 0.8121 vs 0.8113). Band edges sit
-            # between measured points, so every n gets the narrowest width
-            # whose band endpoints sat inside the 0.01 parity band;
+            # parity measurements, r5 re-anchored under adaptive bins
+            # (Covertype RF-100, CV delta vs sklearn in parens):
+            # 5.8k->128 (+0.007 BEATS, 3.6x), 11.6k->128 (-0.006, 6.3 s
+            # = 5.2x), 29k->256 (-0.007, 3.8x), 58k->1024 (+0.0002
+            # BEATS, 127.8 s), 116k->1536+(1536,17,512)+deep16 (-0.0027,
+            # 200.4 s = 2.42x — BASELINE.md r5 sweep table). Band edges
+            # sit between measured points, so every n gets the narrowest
+            # width whose band endpoints sat inside the 0.01 parity band;
             # the smallest deep fits (n just over the 1024 threshold)
             # keep 64-wide arenas.
             bins_cap = _DEEP_BINS_CAP
@@ -255,16 +259,26 @@ class _TreeBase(ModelKernel):
                     width = 128
                 elif n <= 49152:
                     width = 256
-                else:
+                elif n <= 80_000:
+                    # the 58k (50%) parity point BEATS sklearn at 1024
+                    # (0.8121 vs 0.8113, r4) — keep its measured band
                     width = 1024
+                else:
+                    # r5 Pareto: 1536 through the critical mid levels with
+                    # a 512 tail and 48/16 adaptive bins (sweep table in
+                    # BASELINE.md r5) — CV -0.0027 at 2.42x
+                    width = 1536
                 width = min(_DEEP_W, width)
                 if width >= 1024:
-                    # top band: trade bins for width at constant histogram
+                    # top bands: trade bins for width at constant histogram
                     # cost (W x n_bins) — measured strictly better CV. Only
                     # when the wide arena is actually in play (a user pinning
                     # CS230_DEEP_W to a narrower arena keeps the 48-bin cap
                     # its parity points were measured at).
-                    bins_cap = min(bins_cap, _DEEP_BINS_WIDE)
+                    bins_cap = min(
+                        bins_cap,
+                        _DEEP_BINS_WIDEST if width >= 1536 else _DEEP_BINS_WIDE,
+                    )
             depth = levels
             # coarser quantile bins in the deep arena (see sweep table at
             # _DEEP_W): ~1.5x faster histograms AND better CV than 128 —
@@ -280,8 +294,16 @@ class _TreeBase(ModelKernel):
             fine_cap = max(_DEEP_BINS_CAP, bins_cap)
             eff_fine = min(n_bins, fine_cap)
             deep_nb = min(eff_fine, min(bins_cap, _DEEP_BINS_DEEP))
+            nb_occ = _DEEP_BINS_OCC
+            if os.environ.get("CS230_DEEP_BINS_OCC") is None and width == 256:
+                # the 256-wide band needs its LAST pre-saturation level
+                # (W_l=128, candidates=256) fine too: 25% Covertype
+                # measured occ 256 -> CV -0.0104 (outside the band) vs
+                # occ 384 -> -0.0065 at 22.6 s (3.8x). Applied only when
+                # the knob is at its default.
+                nb_occ = 384
             sched_ok = (
-                _DEEP_BINS_OCC > 0
+                nb_occ > 0
                 and deep_nb < eff_fine
                 and eff_fine % deep_nb == 0
             )
@@ -292,7 +314,7 @@ class _TreeBase(ModelKernel):
             if "n_bins" in static and n_bins > cap_used:
                 _warn_deep_bins_clamp(n_bins, cap_used)
             n_bins = min(n_bins, cap_used)
-            nb_sched = (_DEEP_BINS_OCC, deep_nb) if sched_ok else None
+            nb_sched = (nb_occ, deep_nb) if sched_ok else None
         elif depth is None:
             # small data: the complete-tree builder to ~log2(n) levels is
             # already near-purity and cheaper to compile than the arena
@@ -321,7 +343,13 @@ class _TreeBase(ModelKernel):
             out["_W"] = width
             if nb_sched is not None:
                 out["_nb_sched"] = nb_sched
-            if width >= 1024 and n > 80_000 and grow_to_purity and not force_w:
+            if width >= 1536 and n > 80_000 and grow_to_purity and not force_w:
+                # r5 top band: one extra wide level, then a hard 512 tail —
+                # the measured Pareto point (200.4 s, CV 0.8368); the
+                # formula-tail (width//2 = 768) costs ~10% more for no
+                # measured CV
+                out["_wsched"] = (width, 17, 512)
+            elif width >= 1024 and n > 80_000 and grow_to_purity and not force_w:
                 # decaying width schedule at full scale: per-level cost is
                 # linear in frontier width and the deepest levels split
                 # mostly-pure low-gain nodes. Measured on full Covertype
