@@ -68,6 +68,11 @@ class _MLPBase(ModelKernel):
     }
     ignored_params = ModelKernel.ignored_params - {"random_state", "solver", "max_fun"}
 
+    def trace_salt(self):
+        """Fused-path env knobs read at trace time (lane packing) — they
+        change the compiled program without landing in ``static``."""
+        return (os.environ.get("CS230_MLP_K16", ""),)
+
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         hls = static.get("hidden_layer_sizes", (100,))
         if isinstance(hls, (int, float)):

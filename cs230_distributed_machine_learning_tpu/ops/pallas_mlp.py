@@ -35,6 +35,7 @@ does not pay).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Sequence, Tuple
 
 import jax
@@ -220,13 +221,16 @@ def vmem_lane_bytes(dims: Sequence[int], bs: int, solver: str = "adam") -> int:
 
 def pick_k(dims: Sequence[int], bs: int, budget_bytes: int = 48 * 2**20,
            solver: str = "adam") -> int:
-    """Largest k in {8,4,2,1} whose packed state fits the VMEM budget.
+    """Largest k in {16,8,4,2,1} whose packed state fits the VMEM budget.
 
     The budget tracks the raised per-kernel vmem limit (the pallas_call
     passes compiler_params vmem_limit_bytes=100 MB), less headroom for
-    the double-buffered batch blocks."""
+    the double-buffered batch blocks. k=16 (r5) opt-in via
+    CS230_MLP_K16=1 — measured NEUTRAL on config 5 (same 23 s steady):
+    at MNIST dims the kernel is batch-copy-bound, not lane-bound."""
     per = max(vmem_lane_bytes(dims, bs, solver), 1)
-    for k in (8, 4, 2, 1):
+    ks = (16, 8, 4, 2, 1) if os.environ.get("CS230_MLP_K16") == "1" else (8, 4, 2, 1)
+    for k in ks:
         if k * per <= budget_bytes:
             return k
     return 1
