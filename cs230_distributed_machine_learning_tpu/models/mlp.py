@@ -70,8 +70,11 @@ class _MLPBase(ModelKernel):
 
     def trace_salt(self):
         """Fused-path env knobs read at trace time (lane packing) — they
-        change the compiled program without landing in ``static``."""
-        return (os.environ.get("CS230_MLP_K16", ""),)
+        change the compiled program without landing in ``static``. The
+        salt carries the EFFECTIVE boolean, not the raw string: only the
+        exact value "1" changes pick_k, so "0"/"yes"/unset must share one
+        cache key (a raw-string salt would force spurious retraces)."""
+        return ("1" if os.environ.get("CS230_MLP_K16") == "1" else "",)
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         hls = static.get("hidden_layer_sizes", (100,))
