@@ -12,6 +12,13 @@ matching sklearn's ``neg_*`` convention for error metrics).
 
 All metrics take a {0,1} sample-weight vector so they evaluate a masked
 subset of a static-shape array (see ops/folds.py).
+
+CONTRACT — ``w`` is a binary keep-mask, not a general sample weight. The
+averaging metrics happen to generalize to real-valued weights, but the
+RANKING metrics (``weighted_average_precision``, ``weighted_roc_auc_*``)
+use ``w`` only to exclude rows from their count tables and would silently
+ignore weight magnitudes. Callers passing fractional weights get wrong
+scores; the CV engine only ever passes fold masks.
 """
 
 from __future__ import annotations
@@ -118,13 +125,18 @@ def weighted_balanced_accuracy(y_true, y_pred, w, n_classes):
 
 
 def weighted_log_loss(y_true, proba, w, n_classes):
-    """sklearn log_loss over kept rows: -mean log p(true class), with
-    sklearn's probability clipping (eps from the float dtype, matching
-    sklearn >= 1.5's default)."""
+    """log_loss over kept rows: -mean log p(true class), with f32-eps
+    probability clipping then row renormalization. NEAR-parity with
+    sklearn, not exact: sklearn >= 1.5 normalizes rows FIRST and then
+    clips (no renormalize after), with eps from the input dtype — the two
+    orders diverge by O(eps) and only at saturated probabilities, which
+    is inside every kernel's solver tolerance but can differ in the last
+    ulps there."""
     w = w.astype(jnp.float32)
     eps = jnp.finfo(jnp.float32).eps
     p = jnp.clip(proba, eps, 1.0 - eps)
-    # renormalize after clipping exactly as sklearn does
+    # clip-then-renormalize (sklearn normalizes first, then clips — the
+    # O(eps) divergence is documented above)
     p = p / jnp.sum(p, axis=1, keepdims=True)
     classes = jnp.arange(n_classes)
     oh = (y_true[:, None] == classes[None, :]).astype(jnp.float32)
@@ -134,6 +146,10 @@ def weighted_log_loss(y_true, proba, w, n_classes):
 
 def weighted_average_precision(y_true, score, w):
     """Binary average precision from a continuous score, tie-exact.
+
+    ``w`` is a {0,1} KEEP-MASK only (module contract above): rows with
+    w==0 are excluded from the count tables; a fractional weight would be
+    treated as kept with weight 1.
 
     AP = sum over positive rows of precision-at-their-threshold / n_pos,
     where precision at threshold t counts ALL rows with score >= t (the
@@ -208,8 +224,10 @@ def weighted_roc_auc_ovo(y_true, proba, w, n_classes):
 def weighted_roc_auc_binary(y_true, margin, w):
     """Binary ROC-AUC from a continuous decision score, via the average-rank
     formula (ties counted half) — identical to sklearn's trapezoidal
-    roc_auc_score for binary targets. Masked rows are pushed to +inf in the
-    negative-score table so searchsorted never counts them."""
+    roc_auc_score for binary targets. ``w`` is a {0,1} keep-mask (module
+    contract above): masked rows are pushed to +inf in the negative-score
+    table so searchsorted never counts them; weight magnitudes are
+    ignored."""
     keep = w > 0
     neg_scores = jnp.where(keep & (y_true == 0), margin, jnp.inf)
     sorted_neg = jnp.sort(neg_scores)

@@ -44,6 +44,174 @@ def _sds(a):
     return jax.ShapeDtypeStruct(a.shape, a.dtype)
 
 
+# ---- packed single-fetch result transport ---------------------------------
+#
+# Every blocking device->host conversion is its own ~100 ms round trip on a
+# tunneled link, paid PER LEAF of the result pytree — the whole cost floor
+# of tiny jobs (BASELINE configs 1/4, GaussianNB). The trial executables
+# therefore concatenate all result leaves into ONE flat byte buffer inside
+# the jitted computation (bitcast, so f32/int leaves stay bit-identical)
+# and the host fetches that single buffer with one jax.device_get, then
+# reassembles the pytree with zero-copy numpy views.
+
+
+def _packed_enabled() -> bool:
+    """CS230_PACKED_FETCH=0 restores the per-leaf fetch path (debug/parity
+    valve). The flag changes the executable's OUTPUT signature, so it joins
+    every executable cache key via _aot_key."""
+    return os.environ.get("CS230_PACKED_FETCH", "1") != "0"
+
+
+@dataclasses.dataclass(frozen=True)
+class _PackSpec:
+    """Host-side recipe to reassemble a result pytree from one byte buffer."""
+
+    treedef: Any
+    shapes: tuple
+    dtypes: tuple
+    offsets: tuple
+    nbytes: int
+
+
+class _Packed:
+    """A packed device buffer awaiting its single-transfer host fetch."""
+
+    __slots__ = ("buf", "spec")
+
+    def __init__(self, buf, spec: _PackSpec):
+        self.buf = buf
+        self.spec = spec
+
+
+def _pack_spec_of(fn, example_args) -> _PackSpec:
+    """Abstract-trace ``fn`` to learn its output tree; no device work."""
+    out = jax.eval_shape(fn, *example_args)
+    leaves, treedef = jax.tree_util.tree_flatten(out)
+    shapes = tuple(tuple(int(s) for s in l.shape) for l in leaves)
+    dtypes = tuple(np.dtype(l.dtype) for l in leaves)
+    sizes = [
+        int(np.prod(s, dtype=np.int64)) * dt.itemsize
+        for s, dt in zip(shapes, dtypes)
+    ]
+    offs = np.concatenate([[0], np.cumsum(sizes, dtype=np.int64)])
+    return _PackSpec(
+        treedef, shapes, dtypes, tuple(int(o) for o in offs[:-1]), int(offs[-1])
+    )
+
+
+def _pack_wrap(fn):
+    """Wrap a to-be-jitted trial function so its result leaves the device
+    as one flat uint8 buffer (bitcast + concat traced into the executable).
+    Pair with the _PackSpec from ``_pack_spec_of`` on the same example args."""
+
+    def packed(*args):
+        leaves = jax.tree_util.tree_leaves(fn(*args))
+        parts = []
+        for leaf in leaves:
+            leaf = jnp.asarray(leaf)
+            if leaf.dtype == jnp.bool_:
+                leaf = leaf.astype(jnp.uint8)
+            parts.append(jax.lax.bitcast_convert_type(leaf, jnp.uint8).reshape(-1))
+        if not parts:
+            return jnp.zeros((0,), jnp.uint8)
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    return packed
+
+
+def _unpack(buf_np: np.ndarray, spec: _PackSpec):
+    """Reassemble the result pytree from one fetched byte buffer (views,
+    not copies — and bitwise identical to the per-leaf path)."""
+    buf_np = np.ascontiguousarray(buf_np)
+    leaves = []
+    for off, shape, dt in zip(spec.offsets, spec.shapes, spec.dtypes):
+        size = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        raw = buf_np[off : off + size]
+        if dt == np.dtype(bool):
+            leaves.append(raw.view(np.uint8).astype(bool).reshape(shape))
+        else:
+            leaves.append(raw.view(dt).reshape(shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def _fetch_result(out, spec: Optional[_PackSpec]):
+    """One dispatch result -> (host pytree, n_blocking_fetches, bytes).
+
+    Packed results (``spec`` given, or ``out`` already a ``_Packed``) cross
+    the link as ONE buffer via a single device_get; unpacked dicts pay one
+    conversion per leaf — and under a multi-process mesh go through the
+    collective fetch."""
+    if isinstance(out, _Packed):
+        out, spec = out.buf, out.spec
+    if spec is not None:
+        buf = np.asarray(jax.device_get(out))
+        return _unpack(buf, spec), 1, buf.nbytes
+    host = _fetch(out)
+    leaves = jax.tree_util.tree_leaves(host)
+    return host, len(leaves), sum(int(l.nbytes) for l in leaves)
+
+
+# ---- compressed staging uploads -------------------------------------------
+#
+# Cold start spends seconds uploading the f32 design matrix over a ~9 MB/s
+# tunneled link. CS230_STAGE_DTYPE=bf16 halves those bytes (int8 quarters
+# them, with a per-column scale); the executable widens back to f32 on
+# device as its first traced op. Off (f32) by default: bf16 staging moves
+# scores by O(1e-3) (documented tolerance, tests/test_packed_parity.py).
+
+
+def _staging_dtype() -> str:
+    mode = os.environ.get("CS230_STAGE_DTYPE", "f32").lower()
+    return mode if mode in ("bf16", "int8") else "f32"
+
+
+def _stage_compress(X_np: np.ndarray, mode: str):
+    """HOST-side compression right before the upload — the point is fewer
+    bytes on the link, so the narrow form must exist before device_put."""
+    X_np = np.asarray(X_np, np.float32)
+    if mode == "bf16":
+        import ml_dtypes  # availability pre-checked by the caller
+
+        return {"bf16": X_np.astype(ml_dtypes.bfloat16)}
+    if mode == "int8":
+        scale = np.maximum(np.abs(X_np).max(axis=0), 1e-30) / 127.0
+        q = np.clip(np.rint(X_np / scale), -127, 127).astype(np.int8)
+        return {"q8": q, "scale": scale.astype(np.float32)}
+    return X_np
+
+
+def _stage_mode_available(mode: str) -> str:
+    """Downgrade bf16 to f32 when ml_dtypes is missing — decided BEFORE
+    the staging-cache key is formed, so a downgraded staging lands under
+    the plain f32 key (no duplicate dataset copy in HBM)."""
+    if mode == "bf16":
+        try:
+            import ml_dtypes  # noqa: F401
+        except ImportError:
+            return "f32"
+    return mode
+
+
+def _stage_decode(X):
+    """Inverse of ``_stage_compress``, traced into the executable: widen
+    bf16 / dequantize int8 back to the f32 matrix every kernel expects."""
+    if isinstance(X, dict) and "bf16" in X:
+        return X["bf16"].astype(jnp.float32)
+    if isinstance(X, dict) and "q8" in X:
+        return X["q8"].astype(jnp.float32) * X["scale"][None, :]
+    return X
+
+
+def _decode_wrap(fn):
+    """Prepend the staged-X decode to a trial function's X argument (the
+    one shared wrapper for the generic and fused-batched paths)."""
+
+    def wrapped(X, y, TW, EW, hyper):
+        return fn(_stage_decode(X), y, TW, EW, hyper)
+
+    return wrapped
+
+
 def _example_args(X, y, TW, EW, hyper_names, chunk):
     """Shape/dtype skeleton of one dispatch — drives the AOT export trace."""
     hyper = {
@@ -53,7 +221,8 @@ def _example_args(X, y, TW, EW, hyper_names, chunk):
     return (jax.tree_util.tree_map(_sds, X), _sds(y), _sds(TW), _sds(EW), hyper)
 
 
-def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
+def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names,
+             stage_mode="f32", packed=None):
     leaves, treedef = jax.tree_util.tree_flatten(X)
     x_sig = (
         str(treedef),
@@ -69,6 +238,18 @@ def _aot_key(kernel, static, X, n_classes, n_splits, chunk, hyper_names):
         tuple(hyper_names),
         kernel.trace_salt(),
         os.environ.get("CS230_PALLAS_INTERPRET", ""),
+        # transfer-layer knobs that change the executable's I/O signature:
+        # packed output buffer vs per-leaf dict, and the EFFECTIVE staged-X
+        # dtype of this executable (bf16/int8 stagings must never collide
+        # with f32 blobs; the x_sig above carries the staged leaves' actual
+        # dtype, this entry keys the decode wrapper itself). Callers pass
+        # the effective mode, NOT the raw env knob — paths that force f32
+        # (prepare_data/chunked/host/mesh) keep their blobs valid across
+        # knob flips. ``packed`` can likewise be pinned False by callers
+        # whose executable does not pack (chunk_init/chunk_step), keeping
+        # their blobs valid across CS230_PACKED_FETCH flips.
+        _packed_enabled() if packed is None else bool(packed),
+        stage_mode,
     )
 
 
@@ -196,6 +377,12 @@ class TrialRunResult:
     run_time_s: float
     n_dispatches: int
     device_best: Optional[tuple] = None
+    #: blocking device->host result transfers performed (packed path: ONE
+    #: per dispatched result buffer; per-leaf path: one per pytree leaf) —
+    #: the observable the transfer-layer micro-benchmark pins
+    n_host_fetches: int = 0
+    #: bytes crossing the device->host boundary in those fetches
+    result_bytes: int = 0
 
 
 def run_trials(
@@ -228,6 +415,8 @@ def run_trials(
     compile_time = 0.0
     run_time = 0.0
     dispatches = 0
+    n_fetches = 0
+    result_bytes = 0
     # dispatches are queued without blocking and drained at the end: on a
     # remote/tunneled device each round trip costs ~0.25 s of latency, so a
     # multi-bucket job (e.g. a grid over a static param) overlaps its RPCs
@@ -279,8 +468,15 @@ def run_trials(
                 _dev_cache.append(make())
         return _dev_cache[0]
 
+    def _to_host(out):
+        nonlocal n_fetches, result_bytes
+        host, nf, nb = _fetch_result(out, None)
+        n_fetches += nf
+        result_bytes += nb
+        return host
+
     def _drain():
-        nonlocal run_time, t_first_dispatch
+        nonlocal run_time, t_first_dispatch, n_fetches
         # overlap every pending device->host transfer before the first
         # blocking conversion (serial ~100 ms round trips otherwise)
         for bi, bs, _ in pending_best:
@@ -288,19 +484,20 @@ def run_trials(
         for out, _ in pending:
             if isinstance(out, list):
                 for og, _size in out:
-                    _prefetch_async(og)
+                    _prefetch_async(og.buf if isinstance(og, _Packed) else og)
+            elif isinstance(out, _Packed):
+                _prefetch_async(out.buf)
             else:
                 _prefetch_async(out)
         for bi, bs, batch_idx in pending_best:
             pos, score = int(bi), float(bs)
+            n_fetches += 2  # two replicated scalars from the collective argmax
             if pos < len(batch_idx) and np.isfinite(score):
                 _merge_best(batch_idx[pos], score)
         pending_best.clear()
         for out, batch_idx in pending:
-            # fetch (not np.asarray): under a multi-process mesh the trial-
-            # sharded output spans hosts and is assembled collectively
             if isinstance(out, list):  # split-group dispatches: concat folds
-                fetched = [(_fetch(og), size) for og, size in out]
+                fetched = [(_to_host(og), size) for og, size in out]
                 out = {
                     k: np.concatenate(
                         [og[k][:, :size] for og, size in fetched], axis=1
@@ -308,7 +505,7 @@ def run_trials(
                     for k in fetched[0][0]
                 }
             else:
-                out = _fetch(out)
+                out = _to_host(out)
             for j, gi in enumerate(batch_idx):
                 results[gi] = _postprocess(out, j, plan, kernel.task, scoring)
         pending.clear()
@@ -374,6 +571,21 @@ def run_trials(
             ("X", kernel.name, static_key, kernel.trace_salt())
             if hasattr(kernel, "prepare_data") else ("X",)
         )
+        # compressed staging (CS230_STAGE_DTYPE=bf16|int8): the single-device
+        # raw-matrix upload is the cold-start bill (~3.4 s of 7.4 s measured,
+        # BASELINE.md r5 anatomy) — halve/quarter the bytes on the link and
+        # widen back to f32 as the executable's first traced op. Kernels with
+        # prepare_data stage already-compact prepared forms (binned int8)
+        # and are left alone; the host fast path has no link to save.
+        stage_mode = (
+            _stage_mode_available(_staging_dtype())
+            if single_device
+            and not hasattr(kernel, "prepare_data")
+            # chunked-protocol executables never decode (their kernels all
+            # prepare_data today; this guards any future exception)
+            and not chunk_plan
+            else "f32"
+        )
         if host_exec:
             cpu_dev = jax.local_devices(backend="cpu")[0]
             put = lambda a: jax.device_put(np.asarray(a), cpu_dev)  # noqa: E731
@@ -381,21 +593,31 @@ def run_trials(
                 data, x_key + ("host",),
                 lambda: jax.tree_util.tree_map(put, X_np),
             )
+            stage_mode = "f32"
         elif single_device:
-            X = _staged_device(
-                data, x_key + ("dev",),
-                lambda: jax.tree_util.tree_map(jnp.asarray, X_np),
-            )
+            if stage_mode != "f32":
+                X = _staged_device(
+                    data, x_key + ("dev", stage_mode),
+                    lambda: jax.tree_util.tree_map(
+                        jnp.asarray, _stage_compress(X_np, stage_mode)
+                    ),
+                )
+            else:
+                X = _staged_device(
+                    data, x_key + ("dev",),
+                    lambda: jax.tree_util.tree_map(jnp.asarray, X_np),
+                )
         else:
             # mesh path: leave staging to jit's sharding machinery
             X = jax.tree_util.tree_map(jnp.asarray, X_np)
+            stage_mode = "f32"
         if chunk_plan:
             # flush queued generic dispatches first: the chunked bucket runs
             # blocking, and its wall time must not be double-counted inside
             # the generic dispatch window
             _drain()
             y, TW, EW = _dev_args()
-            ct, rt, nd, db = _run_chunked(
+            ct, rt, nd, db, nf, nb = _run_chunked(
                 kernel, static, X, y, TW, EW, hypers, idxs, results,
                 plan, chunk_plan, hyper_names, data,
                 mesh=None if single_device else mesh, trial_axis=trial_axis,
@@ -403,10 +625,13 @@ def run_trials(
             compile_time += ct
             run_time += rt
             dispatches += nd
+            n_fetches += nf
+            result_bytes += nb
             if db is not None:
                 _merge_best(db[0], db[1])
             continue
 
+        out_spec: Optional[_PackSpec] = None
         if host_exec:
             X_d = X
             y_d = put(y_np)
@@ -417,10 +642,16 @@ def run_trials(
             )
             fresh_compile = cache_key not in _compiled_cache
             if fresh_compile:
-                _compiled_cache[cache_key] = jax.jit(
-                    _make_batched(kernel, static, bool(hyper_names))
-                )
-            fn = _compiled_cache[cache_key]
+                raw = _make_batched(kernel, static, bool(hyper_names))
+                spec = None
+                if _packed_enabled():
+                    example = _example_args(
+                        X, y_np, plan.train_w, plan.eval_w, hyper_names, chunk
+                    )
+                    spec = _pack_spec_of(raw, example)
+                    raw = _pack_wrap(raw)
+                _compiled_cache[cache_key] = (jax.jit(raw), spec)
+            fn, out_spec = _compiled_cache[cache_key]
 
         # Kernels with a fused batched path (e.g. the Pallas packed
         # LogisticRegression fit, models/logistic.py) take over the whole
@@ -448,16 +679,28 @@ def run_trials(
             X_d = X
             # one key for both layers: _aot_key carries everything that
             # determines the executable (incl. the interpret-mode env var,
-            # which is baked into the closure at build time)
+            # which is baked into the closure at build time, and the packed/
+            # staging transfer knobs)
             cache_key = ("batched",) + _aot_key(
-                kernel, static, X, data.n_classes, plan.n_splits, chunk, hyper_names
+                kernel, static, X, data.n_classes, plan.n_splits, chunk,
+                hyper_names, stage_mode=stage_mode,
             )
             fresh_compile = cache_key not in _compiled_cache
             if fresh_compile:
+                raw = batched_fn
+                if stage_mode != "f32":
+                    # widen the compressed staged matrix before the fused
+                    # kernel sees it (it expects the f32 design matrix)
+                    raw = _decode_wrap(batched_fn)
                 example = _example_args(X, y_np, plan.train_w, plan.eval_w,
                                         hyper_names, chunk)
-                _compiled_cache[cache_key], _ = aot_jit(batched_fn, cache_key, example)
-            fn = _compiled_cache[cache_key]
+                spec = None
+                if _packed_enabled():
+                    spec = _pack_spec_of(raw, example)
+                    raw = _pack_wrap(raw)
+                compiled, _ = aot_jit(raw, cache_key, example)
+                _compiled_cache[cache_key] = (compiled, spec)
+            fn, out_spec = _compiled_cache[cache_key]
         elif not host_exec:
             y_d, TW_d, EW_d = _dev_args()
             X_d = X
@@ -496,16 +739,18 @@ def run_trials(
                             (jnp.asarray(twg), jnp.asarray(ewg), size))
             if split_groups is not None:
                 TW_g = split_groups[0][0]
-                fn, fresh_compile = _get_compiled(
+                fn, out_spec, fresh_compile = _get_compiled(
                     kernel, static_key, static, mesh, trial_axis, data, plan,
                     chunk, hyper_names, X, y_np,
                     np.asarray(TW_g), np.asarray(split_groups[0][1]),
                     n_splits_override=int(TW_g.shape[0]),
+                    stage_mode=stage_mode,
                 )
             else:
-                fn, fresh_compile = _get_compiled(
+                fn, out_spec, fresh_compile = _get_compiled(
                     kernel, static_key, static, mesh, trial_axis, data, plan,
                     chunk, hyper_names, X, y_np, plan.train_w, plan.eval_w,
+                    stage_mode=stage_mode,
                 )
 
         for start in range(0, len(idxs), chunk):
@@ -538,6 +783,8 @@ def run_trials(
                         # time is steady run time, not compile
                         out_g = jax.block_until_ready(out_g)
                         compile_time += time.perf_counter() - t0
+                    if out_spec is not None:
+                        out_g = _Packed(out_g, out_spec)
                     group_outs.append((out_g, size))
                 pending.append((group_outs, batch_idx))
                 continue
@@ -547,6 +794,8 @@ def run_trials(
                 # XLA compile is attributed; steady-state dispatches queue
                 out = jax.block_until_ready(out)
                 compile_time += time.perf_counter() - t0
+            if out_spec is not None:
+                out = _Packed(out, out_spec)
             if mesh is not None and n_dev > 1:
                 # collective argmax over the trial-sharded score vector: XLA
                 # inserts the ICI all-gather/reduce; only two replicated
@@ -566,6 +815,8 @@ def run_trials(
         run_time_s=run_time,
         n_dispatches=dispatches,
         device_best=device_best,
+        n_host_fetches=n_fetches,
+        result_bytes=result_bytes,
     )
 
 
@@ -769,7 +1020,11 @@ def _mesh_signature(mesh):
 
 def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
                   hyper_names, X_proto=None, y=None, TW=None, EW=None,
-                  n_splits_override=None):
+                  n_splits_override=None, stage_mode="f32"):
+    """Returns (fn, pack_spec_or_None, fresh). Single-device executables
+    take the packed-output form (one uint8 result buffer, see _pack_wrap);
+    mesh executables keep the per-leaf dict — their score vector feeds the
+    on-device collective argmax and the cross-process collective fetch."""
     has_hyper = bool(hyper_names)
     n_splits_key = n_splits_override or plan.n_splits
     # a 1-device mesh is compilation-equivalent to no mesh: drop the
@@ -778,19 +1033,33 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
     n_mesh_dev = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
     if n_mesh_dev == 1:
         mesh = None
+    x_sig = (
+        tuple(
+            (tuple(a.shape), str(a.dtype))
+            for a in jax.tree_util.tree_leaves(X_proto)
+        )
+        if X_proto is not None else None
+    )
     cache_key = (
         kernel.name,
         tuple(sorted((k, str(v)) for k, v in static.items())),
         data.X.shape,
+        x_sig,
+        stage_mode,
+        _packed_enabled(),
         data.n_classes,
         n_splits_key,
         chunk,
         _mesh_signature(mesh),
     )
     if cache_key in _compiled_cache:
-        return _compiled_cache[cache_key], False
+        fn, spec = _compiled_cache[cache_key]
+        return fn, spec, False
 
     batched = _make_batched(kernel, static, has_hyper)
+    if stage_mode != "f32":
+        # widen the compressed staged matrix to f32 before the vmapped fits
+        batched = _decode_wrap(batched)
 
     if mesh is not None:
         replicated = NamedSharding(mesh, P())
@@ -828,17 +1097,23 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
                 in_shardings=(replicated, replicated, replicated, replicated, trial_sharded),
                 out_shardings=trial_sharded,
             )
+        spec = None
     else:
         X_ex = X_proto if X_proto is not None else jax.ShapeDtypeStruct(
             data.X.shape, jnp.float32
         )
         example = _example_args(X_ex, y, TW, EW, hyper_names, chunk)
         disk_key = ("generic",) + _aot_key(
-            kernel, static, X_ex, data.n_classes, n_splits_key, chunk, hyper_names
+            kernel, static, X_ex, data.n_classes, n_splits_key, chunk,
+            hyper_names, stage_mode=stage_mode,
         )
+        spec = None
+        if _packed_enabled():
+            spec = _pack_spec_of(batched, example)
+            batched = _pack_wrap(batched)
         fn, _ = aot_jit(batched, disk_key, example)
-    _compiled_cache[cache_key] = fn
-    return fn, True
+    _compiled_cache[cache_key] = (fn, spec)
+    return fn, spec, True
 
 
 def _run_chunked(
@@ -852,12 +1127,14 @@ def _run_chunked(
     cross-dispatch state is the kernel's accumulator pytree (e.g. summed
     per-tree predictions for a forest). Dispatches are NOT synchronized
     between steps — they pipeline on the device queue; only eval's output is
-    fetched. With ``mesh``, the trial axis of hypers and state is
-    NamedSharded across devices (data replicated) so each chip carries its
-    trial slice through every chunk. Returns (compile_time, run_time,
-    n_dispatches, device_best) — device_best is the collective-argmax winner
-    (submission-order trial index, score) on multi-device meshes with an
-    unsplit fold stack, else None.
+    fetched (packed into one byte buffer on the single-device path, so the
+    whole bucket's scores cross the link as ONE transfer). With ``mesh``,
+    the trial axis of hypers and state is NamedSharded across devices (data
+    replicated) so each chip carries its trial slice through every chunk.
+    Returns (compile_time, run_time, n_dispatches, device_best,
+    n_host_fetches, result_bytes) — device_best is the collective-argmax
+    winner (submission-order trial index, score) on multi-device meshes
+    with an unsplit fold stack, else None.
     """
     n_chunks = int(chunk_plan["n_chunks"])
     n_dev = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
@@ -919,15 +1196,21 @@ def _run_chunked(
         split_groups.append((twg, ewg, size))
     TW_ex, EW_ex = split_groups[0][0], split_groups[0][1]
 
+    # packed=False: init/step executables never pack (their state stays on
+    # device), so their disk blobs must survive CS230_PACKED_FETCH flips;
+    # only chunk_eval's key (below) carries the live flag
     base_key_parts = _aot_key(
-        kernel, static, X, data.n_classes, sg, chunk, hyper_names
+        kernel, static, X, data.n_classes, sg, chunk, hyper_names,
+        packed=False,
     ) + (n_chunks, chunk_plan.get("trees_per_chunk"))
-    cache_tag = ("chunked",) + base_key_parts + (
+    cache_tag = ("chunked",) + base_key_parts + (_packed_enabled(),) + (
         (_mesh_signature(mesh),) if mesh is not None else ()
     )
     compile_time = 0.0
     run_time = 0.0
     dispatches = 0
+    n_fetches = 0
+    result_bytes = 0
     device_best = None
     fresh = cache_tag not in _compiled_cache
     if fresh:
@@ -968,25 +1251,34 @@ def _run_chunked(
                 in_shardings=(X_sh, repl, repl, repl, h_sh, st_sh),
                 out_shardings=jax.tree_util.tree_map(lambda _: tsh, out_ex),
             )
+            fe_spec = None
         else:
             Xe = jax.tree_util.tree_map(_sds, X)
             args_ie = (Xe, _sds(y), _sds(TW_ex), _sds(EW_ex), hyper_ex)
             fi, _ = aot_jit(vinit, ("chunk_init",) + base_key_parts, args_ie)
             state_ex = jax.eval_shape(vinit, X, y, TW_ex, EW_ex, hyper_ex)
+            args_e = args_ie + (jax.tree_util.tree_map(_sds, state_ex),)
             fs, _ = aot_jit(
                 vstep,
                 ("chunk_step",) + base_key_parts,
                 args_ie + (jax.ShapeDtypeStruct((), jnp.int32),)
                 + (jax.tree_util.tree_map(_sds, state_ex),),
             )
+            # only eval's output crosses to host: pack it (init/step state
+            # stays device-resident across the pipelined dispatches)
+            ev = veval
+            fe_spec = None
+            if _packed_enabled():
+                fe_spec = _pack_spec_of(veval, args_e)
+                ev = _pack_wrap(veval)
             fe, _ = aot_jit(
-                veval,
-                ("chunk_eval",) + base_key_parts,
-                args_ie + (jax.tree_util.tree_map(_sds, state_ex),),
+                ev,
+                ("chunk_eval",) + base_key_parts + (_packed_enabled(),),
+                args_e,
             )
-        _compiled_cache[cache_tag] = (fi, fs, fe)
+        _compiled_cache[cache_tag] = (fi, fs, fe, fe_spec)
         compile_time += time.perf_counter() - t_build
-    fi, fs, fe = _compiled_cache[cache_tag]
+    fi, fs, fe, fe_spec = _compiled_cache[cache_tag]
 
     for start in range(0, len(idxs), chunk):
         batch_idx = idxs[start : start + chunk]
@@ -1017,13 +1309,20 @@ def _run_chunked(
                 group_outs[0][0]["score"], jnp.int32(len(batch_idx))
             )
             pos, score = int(bi), float(bs)
+            n_fetches += 2
             if pos < len(batch_idx) and np.isfinite(score) and (
                 device_best is None or score > device_best[1]
             ):
                 device_best = (batch_idx[pos], score)
         for og, _size in group_outs:
             _prefetch_async(og)
-        group_outs = [(_fetch(og), size) for og, size in group_outs]
+        fetched = []
+        for og, size in group_outs:
+            host, nf, nb = _fetch_result(og, fe_spec)
+            n_fetches += nf
+            result_bytes += nb
+            fetched.append((host, size))
+        group_outs = fetched
         out = {
             k: np.concatenate([og[k][:, :size] for og, size in group_outs], axis=1)
             for k in group_outs[0][0]
@@ -1036,7 +1335,7 @@ def _run_chunked(
                 out, j, plan, kernel.task, static.get("_scoring")
             )
 
-    return compile_time, run_time, dispatches, device_best
+    return compile_time, run_time, dispatches, device_best, n_fetches, result_bytes
 
 
 def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str,

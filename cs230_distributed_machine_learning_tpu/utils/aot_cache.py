@@ -14,11 +14,15 @@ state survives process boundaries so the steady-state cost, not the cold
 cost, is what jobs pay.
 
 Entries are keyed by the executable identity (kernel/static/shapes/splits/
-chunk), the lowering platform, the jax version, and a content fingerprint of
-this package's compute-path sources — a code change invalidates every blob,
-so a stale cache can never resurrect old kernel behavior. Any failure to
-export/serialize/deserialize falls back silently to the traced path
-(CS230_AOT_CACHE=0 disables the cache outright).
+chunk — trial_map._aot_key, which also folds in the transfer-layer knobs:
+the packed-output flag and the staging dtype, plus the staged leaves' own
+shape/dtype signature, so bf16/int8-staged and packed/per-leaf executables
+never collide with their f32/dict counterparts), the lowering platform, the
+jax version, and a content fingerprint of this package's compute-path
+sources — a code change invalidates every blob, so a stale cache can never
+resurrect old kernel behavior. Any failure to export/serialize/deserialize
+falls back silently to the traced path (CS230_AOT_CACHE=0 disables the
+cache outright).
 """
 
 from __future__ import annotations

@@ -101,11 +101,27 @@ def setup_jax(cache_dir: str | None = None) -> None:
         # subdirectory; different ones never see each other's binaries.
         import hashlib
 
+        # NO host CPU fingerprint here, mirroring aot_cache._generation():
+        # only accelerator-resolved processes reach this point (CPU-resolved
+        # ones returned above, uncached), and accelerator executables are
+        # device code — folding the host CPU into their cache signature
+        # would make TPU hosts with heterogeneous CPUs sharing a storage
+        # root re-pay the 5-40 s first-compile each (ADVICE r5 #2).
+        # Residual exposure, accepted with that (performance-only-rated)
+        # ADVICE trade: an accelerator process's host-fast-path buckets
+        # (trial_map host_exec) compile on the XLA CPU backend into this
+        # same shared dir, so heterogeneous hosts can see each other's
+        # CPU-lowered entries. Observed behavior in this environment is
+        # the cpu_aot_loader feature-mismatch error + fresh recompile
+        # (same-host reloads always false-mismatch, see the comment
+        # above); the harder SIGILL outcome documented for mismatched CPU
+        # entries has not been observed for these, but a fleet hitting it
+        # should re-partition by setting CS230_AOT_DIR/cache_dir per host
+        # class.
         ctx = "|".join((
             os.environ.get("XLA_FLAGS", ""),
             os.environ.get("JAX_PLATFORMS", ""),
             platform or "",
-            host_fingerprint(),
         ))
         sig = hashlib.sha256(ctx.encode()).hexdigest()[:10]
         cache_dir = os.path.join(

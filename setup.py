@@ -1,33 +1,5 @@
-from setuptools import find_packages, setup
+"""Legacy shim — all packaging metadata lives in pyproject.toml."""
 
-setup(
-    name="cs230-distributed-machine-learning-tpu",
-    version="0.4.0",
-    description=(
-        "TPU-native distributed ML training and hyperparameter-search framework "
-        "(JAX/XLA re-design of the distributed-ml task farm)"
-    ),
-    packages=find_packages(include=["cs230_distributed_machine_learning_tpu*"]),
-    python_requires=">=3.10",
-    install_requires=[
-        "jax",
-        "numpy",
-        "pandas",
-        "scikit-learn",
-        "pyyaml",
-        # in-fit resource sampling (runtime/executor.ResourceSampler) feeds
-        # the runtime predictor's cpu/mem features
-        "psutil",
-    ],
-    extras_require={
-        "client": ["requests", "tqdm"],
-        "server": ["werkzeug"],
-    },
-    entry_points={
-        "console_scripts": [
-            # deployment surface (reference: docker-compose.yml services)
-            "tpuml-coordinator=cs230_distributed_machine_learning_tpu.runtime.server:main",
-            "tpuml-agent=cs230_distributed_machine_learning_tpu.runtime.agent:main",
-        ]
-    },
-)
+from setuptools import setup
+
+setup()

@@ -92,6 +92,7 @@ def _run_fleet(chaos: bool):
         raise
 
 
+@pytest.mark.slow  # 1000-trial 4-agent kill-mid-job fleet: minutes of wall
 def test_chaos_1000_trials_agent_killed_mid_job(fast_cfg):
     healthy, cluster_h, _ = _run_fleet(chaos=False)
     cluster_h.shutdown()

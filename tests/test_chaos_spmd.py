@@ -29,6 +29,10 @@ import urllib.request
 
 import pytest
 
+#: subprocess chaos harness (2-rank gloo mesh + REST fleet, minutes of
+#: wall): excluded from the tier-1 -m 'not slow' budget
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SERVER_SCRIPT = """

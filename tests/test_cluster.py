@@ -187,6 +187,7 @@ def test_device_fatal_classification():
     assert not _is_device_fatal(RuntimeError("bad hyperparameter"))
 
 
+@pytest.mark.slow  # Popens real agent children (fresh jax imports)
 def test_agent_supervisor_respawns_dead_child(tmp_path):
     """Supervisor restart policy: a child that exits is respawned with
     backoff; stop() terminates children."""
@@ -220,6 +221,7 @@ def test_agent_supervisor_respawns_dead_child(tmp_path):
         sup.stop()
 
 
+@pytest.mark.slow  # exercises Popen restart/backoff with real children
 def test_supervisor_spawn_failure_backs_off(tmp_path):
     """A persistently failing Popen must consume the restart budget with
     backoff, not retry every poll tick forever."""

@@ -19,6 +19,10 @@ import urllib.request
 
 import pytest
 
+#: two real OS processes forming one SPMD mesh over gloo: excluded
+#: from the tier-1 -m 'not slow' budget
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SERVER_SCRIPT = """

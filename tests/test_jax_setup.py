@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -19,6 +21,7 @@ def _run(script, extra_env=None):
     )
 
 
+@pytest.mark.slow  # spawns a fresh interpreter importing jax (~10 s)
 def test_tpuml_platform_pins_backend():
     r = _run(
         "from cs230_distributed_machine_learning_tpu.utils.jax_setup import setup_jax\n"
@@ -31,6 +34,7 @@ def test_tpuml_platform_pins_backend():
     assert "BACKEND=cpu" in r.stdout, r.stdout
 
 
+@pytest.mark.slow  # spawns a fresh interpreter importing jax (~10 s)
 def test_cpu_pin_skips_persistent_compile_cache():
     r = _run(
         "from cs230_distributed_machine_learning_tpu.utils.jax_setup import setup_jax\n"
@@ -43,6 +47,7 @@ def test_cpu_pin_skips_persistent_compile_cache():
     assert "CACHEDIR=None" in r.stdout, r.stdout
 
 
+@pytest.mark.slow  # spawns a fresh interpreter importing jax (~10 s)
 def test_cache_dir_partitioned_by_context():
     script = (
         "from cs230_distributed_machine_learning_tpu.utils.jax_setup import setup_jax\n"
@@ -79,9 +84,14 @@ def test_cache_dir_partitioned_by_host_fingerprint():
     assert host_fingerprint() == fp
 
 
-def test_host_fingerprint_in_cache_dir():
-    """The resolved cache dir must change when the host fingerprint does —
-    patched via the module hook so the test exercises setup_jax itself."""
+@pytest.mark.slow  # spawns a fresh interpreter importing jax (~10 s)
+def test_host_fingerprint_not_in_accelerator_cache_dir():
+    """Accelerator-resolved processes on hosts with DIFFERENT CPUs must
+    share one compile-cache dir (mirroring aot_cache._generation(): TPU
+    executables are device code; partitioning them by host CPU would make
+    every CPU type on a shared storage root re-pay the 5-40 s
+    first-compile, ADVICE r5 #2). The fingerprint partitions only
+    cpu-resolved contexts — which skip the persistent cache entirely."""
     script = (
         "from cs230_distributed_machine_learning_tpu.utils import jax_setup\n"
         "jax_setup.host_fingerprint = lambda: {fp!r}\n"
@@ -94,9 +104,10 @@ def test_host_fingerprint_in_cache_dir():
     assert a.returncode == 0 and b.returncode == 0, (a.stderr[-300:], b.stderr[-300:])
     da = a.stdout.split("CACHEDIR=")[1].strip()
     db = b.stdout.split("CACHEDIR=")[1].strip()
-    assert da != db and da != "None" and db != "None", (da, db)
+    assert da == db and da != "None", (da, db)
 
 
+@pytest.mark.slow  # spawns a fresh interpreter importing jax (~10 s)
 def test_aot_cache_disabled_on_cpu_backend():
     r = _run(
         "import jax\n"

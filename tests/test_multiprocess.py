@@ -15,6 +15,10 @@ import urllib.request
 
 import pytest
 
+#: compose-equivalent subprocess fleet (fresh interpreters importing
+#: jax): excluded from the tier-1 -m 'not slow' budget
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 SERVER_SCRIPT = """
