@@ -1,0 +1,124 @@
+"""Micro-benchmark: the CS230_OBS=0 disabled path must be near-free.
+
+Acceptance guard for the observability layer (ISSUE 2): with the valve
+off, an instrumented executor run must show no measurable regression vs.
+the same instrumented code — i.e. the per-call cost of the disabled
+helpers (one env read each) must vanish into run-to-run noise on a real
+tiny-job hot path.
+
+Protocol: one warm-up + N timed ``LocalExecutor.run_subtasks`` calls on a
+small LogisticRegression batch (the dispatch-floor-bound shape, BASELINE
+config 1 spirit), alternating valve states to cancel drift; medians and
+spreads per state -> benchmarks/OBS_OVERHEAD_MICRO.json. The valve is
+read per call site, so flipping the env var mid-process is the real
+disabled path, not a proxy.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/obs_overhead_micro.py
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PASSES = 9
+N_TRIALS = 8
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    materialize_builtin("iris")
+    executor = LocalExecutor()
+    subtasks = create_subtasks(
+        "obs-micro", "sess", "iris",
+        {
+            "model_type": "LogisticRegression",
+            "search_type": "GridSearchCV",
+            "base_estimator_params": {"max_iter": 200},
+            "param_grid": {"C": [0.1 * (i + 1) for i in range(N_TRIALS)]},
+        },
+        {"test_size": 0.2, "random_state": 0, "cv": 3},
+    )
+    # trace ids attached like a real coordinator submission, so the
+    # enabled path opens real spans and the disabled path walks the same
+    # instrumented call sites
+    for st in subtasks:
+        st["trace_id"] = "obsmicro00000000"
+
+    def timed_run() -> float:
+        t0 = time.perf_counter()
+        results = executor.run_subtasks([dict(st) for st in subtasks])
+        assert all(r["status"] == "completed" for r in results)
+        return time.perf_counter() - t0
+
+    # warm-up: compile + caches out of the measurement
+    os.environ["CS230_OBS"] = "1"
+    timed_run()
+    os.environ["CS230_OBS"] = "0"
+    timed_run()
+
+    samples = {"0": [], "1": []}
+    for i in range(2 * N_PASSES):
+        state = "0" if i % 2 == 0 else "1"  # alternate to cancel drift
+        os.environ["CS230_OBS"] = state
+        samples[state].append(timed_run())
+
+    def stats(xs):
+        med = statistics.median(xs)
+        return {
+            "median_s": med,
+            "min_s": min(xs),
+            "spread": (max(xs) - min(xs)) / med if med else None,
+            "samples": xs,
+        }
+
+    disabled, enabled = stats(samples["0"]), stats(samples["1"])
+    overhead = (
+        (disabled["median_s"] - enabled["median_s"]) / enabled["median_s"]
+        if enabled["median_s"]
+        else None
+    )
+    out = {
+        "benchmark": "obs_overhead_micro",
+        "config": {"n_trials": N_TRIALS, "cv": 3, "dataset": "iris",
+                   "model": "LogisticRegression", "passes_per_state": N_PASSES},
+        "backend": _backend(),
+        "disabled_CS230_OBS_0": disabled,
+        "enabled_CS230_OBS_1": enabled,
+        "disabled_minus_enabled_relative": overhead,
+        "verdict": (
+            "disabled path within noise of enabled"
+            if overhead is not None and abs(overhead) <= max(
+                disabled["spread"] or 0, enabled["spread"] or 0
+            )
+            else "see samples"
+        ),
+    }
+    path = os.path.join(os.path.dirname(__file__), "OBS_OVERHEAD_MICRO.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    json.dump(out, sys.stdout, indent=2)
+    print()
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+if __name__ == "__main__":
+    main()

@@ -21,10 +21,12 @@ polling /metrics (core.py:169,178), and the 60 s default timeout.
 
 from __future__ import annotations
 
+import json
 import time
 import uuid
 from typing import Any, Dict, Optional
 
+from ..obs import TRACE_HEADER, activate, new_trace_id, span
 from ..utils.config import get_config
 from ..utils.serialization import json_safe
 from .introspection import extract_model_details
@@ -44,6 +46,10 @@ class MLTaskManager:
         self.session_id = self._create_session()
         self.job_id: Optional[str] = None
         self.result: Optional[Dict[str, Any]] = None
+        #: trace id of the most recent train() — minted client-side and
+        #: propagated to the coordinator (X-Trace-Id header on REST, trace
+        #: context in local mode); read GET /trace/<job_id> with it
+        self.trace_id: Optional[str] = None
 
     # ------------- session -------------
 
@@ -98,6 +104,7 @@ class MLTaskManager:
         show_progress: bool = True,
         *,
         dataset_name: Optional[str] = None,
+        stream: bool = False,
     ) -> Dict[str, Any]:
         """Submit a training / hyperparameter-search job.
 
@@ -105,6 +112,14 @@ class MLTaskManager:
         estimator default test_size matches the reference (core.py:160-163).
         ``dataset_name=`` is accepted as an alias for ``dataset_id`` — the
         reference README's examples use that keyword (README.md:70-76).
+
+        ``stream=True`` (with ``wait_for_completion``) follows the job by
+        CONSUMING the server-sent-event stream instead of polling: remote
+        mode posts to ``/train_status`` and reads its SSE body (the
+        reference client posted there and then ignored the stream,
+        core.py:169 — fixed, not copied); local mode consumes the
+        coordinator's ``stream_status`` generator. Progress events update
+        the progress bar; the final event carries ``job_result``.
         """
         if dataset_name is not None:
             if dataset_id is not None and dataset_id != dataset_name:
@@ -127,8 +142,17 @@ class MLTaskManager:
             "train_params": train_params,
             "timestamp": time.time(),
         }
+        self.trace_id = new_trace_id()
         if self._coordinator is not None:
-            submit = self._coordinator.submit_train(self.session_id, payload)
+            # local mode: the job trace starts here — activate the id so
+            # submit_train (same process) adopts it, bracketed by a
+            # client-side span
+            with activate(self.trace_id):
+                with span("client.train", trace_id=self.trace_id,
+                          job_id=self.job_id, dataset_id=dataset_id):
+                    submit = self._coordinator.submit_train(
+                        self.session_id, payload
+                    )
         else:
             scoring = (model_details.get("cv_params") or {}).get("scoring")
             if callable(scoring) and not isinstance(scoring, str):
@@ -142,11 +166,19 @@ class MLTaskManager:
                     "name, or a local-mode MLTaskManager for callable "
                     "scorers"
                 )
+            if stream and wait_for_completion:
+                # /train_status both submits AND streams: one request
+                return self._train_stream(
+                    payload, timeout=timeout, show_progress=show_progress
+                )
             submit = self._request(
-                "post", f"train/{self.session_id}", json=json_safe(payload)
+                "post", f"train/{self.session_id}", json=json_safe(payload),
+                headers={TRACE_HEADER: self.trace_id},
             )
         if not wait_for_completion:
             return submit
+        if stream and self._coordinator is not None:
+            return self._stream_local(timeout=timeout, show_progress=show_progress)
         return self._wait_for_completion(timeout=timeout, show_progress=show_progress)
 
     def _wait_for_completion(
@@ -155,16 +187,7 @@ class MLTaskManager:
         cfg = get_config().service
         timeout = timeout or cfg.client_timeout_s
         poll = cfg.client_poll_s if self._coordinator is None else 0.1
-        bar = None
-        if show_progress:
-            try:
-                from tqdm import tqdm
-
-                # disable=None: auto-off when stderr is not a tty (piped
-                # logs otherwise get one bar line per poll tick)
-                bar = tqdm(total=100, desc="job", unit="%", disable=None)
-            except ImportError:
-                bar = None
+        bar = self._progress_bar(show_progress)
         deadline = time.time() + timeout
         try:
             while time.time() < deadline:
@@ -191,6 +214,108 @@ class MLTaskManager:
             if bar is not None:
                 bar.close()
         raise TimeoutError(f"Job {self.job_id} did not complete within {timeout}s")
+
+    # ------------- SSE streaming (stream=True) -------------
+
+    @staticmethod
+    def _progress_bar(show_progress: bool):
+        if not show_progress:
+            return None
+        try:
+            from tqdm import tqdm
+
+            # disable=None: auto-off when stderr is not a tty (piped
+            # logs otherwise get one bar line per poll tick)
+            return tqdm(total=100, desc="job", unit="%", disable=None)
+        except ImportError:
+            return None
+
+    def _finish_stream(self, last: Optional[Dict[str, Any]], timeout: float):
+        if last is None or last.get("job_status") not in ("completed", "failed"):
+            raise TimeoutError(
+                f"Job {self.job_id} stream ended without completion "
+                f"(timeout {timeout}s)"
+            )
+        self.result = last.get("job_result")
+        return last
+
+    def _stream_local(
+        self, timeout: Optional[float] = None, show_progress: bool = True
+    ) -> Dict[str, Any]:
+        """Local-mode stream consumption: iterate the coordinator's
+        ``stream_status`` generator (the SSE body source) to completion."""
+        timeout = timeout or get_config().service.client_timeout_s
+        deadline = time.time() + timeout
+        bar = self._progress_bar(show_progress)
+        last: Optional[Dict[str, Any]] = None
+        try:
+            for progress in self._coordinator.stream_status(
+                self.session_id, self.job_id
+            ):
+                last = progress
+                if bar is not None:
+                    bar.n = int(_pct(progress.get("job_status")))
+                    bar.refresh()
+                if progress.get("job_status") in ("completed", "failed"):
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"Job {self.job_id} did not complete within {timeout}s"
+                    )
+        finally:
+            if bar is not None:
+                bar.close()
+        return self._finish_stream(last, timeout)
+
+    def _train_stream(
+        self,
+        payload: Dict[str, Any],
+        timeout: Optional[float] = None,
+        show_progress: bool = True,
+    ) -> Dict[str, Any]:
+        """Remote-mode stream consumption: POST the job to ``/train_status``
+        and read the SSE events off the response body (one request submits
+        and follows). Events arrive every ``sse_tick_s``; a read stalled
+        well past that cadence — or the overall deadline — raises."""
+        import requests
+
+        cfg = get_config().service
+        timeout = timeout or cfg.client_timeout_s
+        deadline = time.time() + timeout
+        read_timeout = max(10.0, 8 * cfg.sse_tick_s)
+        bar = self._progress_bar(show_progress)
+        last: Optional[Dict[str, Any]] = None
+        resp = requests.post(
+            f"{self.api_url}/train_status/{self.session_id}",
+            json=json_safe(payload),
+            headers={TRACE_HEADER: self.trace_id} if self.trace_id else None,
+            stream=True,
+            timeout=(10, read_timeout),
+        )
+        try:
+            resp.raise_for_status()
+            for raw in resp.iter_lines():
+                if not raw:
+                    continue
+                line = raw.decode() if isinstance(raw, bytes) else raw
+                if not line.startswith("data: "):
+                    continue
+                event = json.loads(line[len("data: "):])
+                last = event
+                if bar is not None:
+                    bar.n = int(_pct(event.get("job_status")))
+                    bar.refresh()
+                if event.get("job_status") in ("completed", "failed"):
+                    break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"Job {self.job_id} did not complete within {timeout}s"
+                    )
+        finally:
+            resp.close()
+            if bar is not None:
+                bar.close()
+        return self._finish_stream(last, timeout)
 
     # ------------- status / results -------------
 
@@ -250,13 +375,15 @@ class MLTaskManager:
 
     # ------------- REST plumbing -------------
 
-    def _request(self, method: str, endpoint: str, json=None, params=None) -> Dict[str, Any]:
+    def _request(
+        self, method: str, endpoint: str, json=None, params=None, headers=None
+    ) -> Dict[str, Any]:
         import requests
 
         url = f"{self.api_url}/{endpoint.lstrip('/')}"
         resp = requests.request(
             method, url, json=json_safe(json) if json is not None else None,
-            params=params, timeout=600,
+            params=params, headers=headers, timeout=600,
         )
         resp.raise_for_status()
         return resp.json()

@@ -1,0 +1,200 @@
+"""Observability facade: unified metrics registry + end-to-end job tracing.
+
+Every runtime layer instruments through this module, never through
+``metrics``/``tracing`` directly, because the facade owns the one global
+valve:
+
+    CS230_OBS=0   -> every helper below is a near-free no-op (one env
+                     read); ``span()`` yields a shared inert handle.
+
+The two subsystems:
+
+- :mod:`.metrics` — thread-safe counters/gauges/histograms exposed in
+  Prometheus text format at ``GET /metrics/prom``. The full family
+  catalog is registered eagerly below so scrapes see every name from the
+  first request (documented in docs/OBSERVABILITY.md).
+- :mod:`.tracing` — Dapper-style spans with ``trace_id`` propagated over
+  the REST control plane (``X-Trace-Id`` header, task-spec stamping,
+  agent span shipping); ``GET /trace/<job_id>`` returns the span tree.
+
+Usage (hot paths pay one env check when disabled):
+
+    from ..obs import obs_enabled, counter_inc, observe, span
+
+    counter_inc("tpuml_subtasks_completed_total")
+    observe("tpuml_executor_fetch_seconds", dt)
+    with span("executor.batch", trace_id=tid, worker=wid) as sp:
+        sp.attrs["n_dispatches"] = run.n_dispatches
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .metrics import (  # noqa: F401 — re-exported API
+    DEFAULT_BUCKETS,
+    PLACEMENT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    REGISTRY,
+)
+from .tracing import _enabled as _valve
+from .tracing import (  # noqa: F401 — re-exported API
+    TRACE_HEADER,
+    TRACER,
+    Tracer,
+    activate,
+    active_tracer,
+    current_trace_id,
+    new_trace_id,
+    record_phase,
+    span,
+    use_tracer,
+)
+
+
+def obs_enabled() -> bool:
+    """The master valve (single definition: tracing._enabled). Read per
+    call (one env lookup) so tests and operators can flip ``CS230_OBS``
+    on a live process."""
+    return _valve()
+
+
+# ---------------- valve-gated metric helpers ----------------
+
+
+def counter_inc(name: str, amount: float = 1.0, **labels: str) -> None:
+    if not obs_enabled():
+        return
+    REGISTRY.counter(name).inc(amount, **labels)
+
+
+def gauge_set(name: str, value: float, **labels: str) -> None:
+    if not obs_enabled():
+        return
+    REGISTRY.gauge(name).set(value, **labels)
+
+
+def observe(
+    name: str,
+    value: float,
+    buckets: Optional[Sequence[float]] = None,
+    **labels: str,
+) -> None:
+    if not obs_enabled():
+        return
+    if buckets is not None:
+        REGISTRY.histogram(name, buckets=buckets).observe(value, **labels)
+    else:
+        REGISTRY.histogram(name).observe(value, **labels)
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render()
+
+
+# ---------------- metric catalog ----------------
+#
+# Registered eagerly so every family is present (at zero) in the first
+# scrape. Names, types, and meanings are documented in
+# docs/OBSERVABILITY.md — keep the two in sync.
+
+_CATALOG_REGISTERED = False
+
+
+def register_catalog() -> None:
+    global _CATALOG_REGISTERED
+    if _CATALOG_REGISTERED:
+        return
+    _CATALOG_REGISTERED = True
+    c, g, h = REGISTRY.counter, REGISTRY.gauge, REGISTRY.histogram
+    c("tpuml_jobs_submitted_total", "Train jobs accepted by the coordinator")
+    c("tpuml_jobs_completed_total", "Jobs finalized successfully")
+    c("tpuml_jobs_failed_total", "Jobs finalized as failed")
+    c(
+        "tpuml_subtasks_dispatched_total",
+        "Subtasks placed onto a worker by the scheduler (requeues re-count)",
+    )
+    c("tpuml_subtasks_completed_total", "Subtask executions that completed")
+    c("tpuml_subtasks_failed_total", "Subtask executions that failed")
+    c(
+        "tpuml_subtasks_requeued_total",
+        "Subtasks requeued off a dead/unsubscribed worker",
+    )
+    c("tpuml_agent_polls_total", "GET /next_tasks long-polls served")
+    c(
+        "tpuml_agent_tasks_pulled_total",
+        "Subtasks handed to remote agents over /next_tasks",
+    )
+    c(
+        "tpuml_agent_acks_total",
+        "Task results acknowledged over POST /task_result",
+    )
+    c(
+        "tpuml_executable_cache_hits_total",
+        "In-process compiled-executable cache hits (trial engine)",
+    )
+    c(
+        "tpuml_executable_cache_misses_total",
+        "In-process compiled-executable cache misses (trial engine)",
+    )
+    c("tpuml_aot_cache_hits_total", "AOT disk-cache blob deserializations")
+    c(
+        "tpuml_aot_cache_misses_total",
+        "AOT disk-cache misses (fresh trace/export)",
+    )
+    c("tpuml_http_requests_total", "REST requests served, labeled by endpoint")
+    c("tpuml_trace_spans_ingested_total", "Remote spans accepted via /trace_spans")
+    g("tpuml_workers_alive", "Workers currently registered with the scheduler")
+    h(
+        "tpuml_scheduler_placement_seconds",
+        "Placement-decision latency (place() wall time)",
+        buckets=PLACEMENT_BUCKETS,
+    )
+    h(
+        "tpuml_executor_compile_seconds",
+        "Per-bucket executable construction (trace/AOT-load + first-dispatch compile)",
+    )
+    h(
+        "tpuml_executor_stage_seconds",
+        "Host->device staging uploads (dataset/fold tensors, cache misses only)",
+    )
+    h(
+        "tpuml_executor_dispatch_seconds",
+        "Per-batch device execution window (dispatch to last result ready)",
+    )
+    h(
+        "tpuml_executor_fetch_seconds",
+        "Blocking device->host result fetches",
+    )
+
+
+register_catalog()
+
+__all__ = [
+    "obs_enabled",
+    "counter_inc",
+    "gauge_set",
+    "observe",
+    "render_prometheus",
+    "register_catalog",
+    "REGISTRY",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "DEFAULT_BUCKETS",
+    "PLACEMENT_BUCKETS",
+    "TRACER",
+    "Tracer",
+    "TRACE_HEADER",
+    "span",
+    "record_phase",
+    "activate",
+    "use_tracer",
+    "active_tracer",
+    "current_trace_id",
+    "new_trace_id",
+]

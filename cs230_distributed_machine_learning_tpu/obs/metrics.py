@@ -1,0 +1,269 @@
+"""Thread-safe in-process metrics registry with Prometheus text exposition.
+
+The permanent replacement for the one-off benchmark harnesses VERDICT
+weaknesses 1/4/5 were diagnosed with: counters, gauges, and fixed-bucket
+histograms that every runtime layer (coordinator, scheduler, executor,
+trial engine, REST server) increments in place, scraped as standard
+Prometheus text format at ``GET /metrics/prom`` (runtime/server.py).
+
+Design constraints:
+
+- **Thread-safe**: the coordinator's job threads, the cluster's worker
+  loops, and the werkzeug request threads all write concurrently. Each
+  metric guards its label-keyed cells with one lock; increments are
+  dict-op cheap.
+- **Near-free when disabled**: callers go through the ``obs`` facade
+  (``obs/__init__.py``), which checks the ``CS230_OBS`` valve before ever
+  touching the registry — a disabled increment is one env read.
+- **Stable catalog**: metric families are registered eagerly at import
+  (``obs/__init__.py``), so ``/metrics/prom`` exposes every family (at
+  zero) from the first scrape — scrapers and the parsing test never see a
+  name flicker into existence.
+
+Exposition follows the Prometheus text format v0.0.4: ``# HELP``/``# TYPE``
+per family; histograms emit cumulative ``_bucket{le=...}`` series plus
+``_sum``/``_count``.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+#: default latency buckets (seconds) — spans sub-ms placement decisions
+#: through multi-minute compiles
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+
+#: finer buckets for the placement decision (lock + min over workers:
+#: microseconds on small pools)
+PLACEMENT_BUCKETS: Tuple[float, ...] = (
+    1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0,
+)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: LabelKey, extra: Optional[Tuple[str, str]] = None) -> str:
+    items = list(key) + ([extra] if extra else [])
+    if not items:
+        return ""
+    body = ",".join(
+        f'{k}="{v}"' for k, v in items
+    )
+    return "{" + body + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class Counter:
+    """Monotonic counter, optionally labeled. Values are floats (Prometheus
+    counters are)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} counter",
+        ]
+        with self._lock:
+            cells = sorted(self._values.items()) or [((), 0.0)]
+        for key, v in cells:
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}")
+        return out
+
+
+class Gauge:
+    """Last-written value, optionally labeled."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels: str) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def render(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} gauge",
+        ]
+        with self._lock:
+            cells = sorted(self._values.items()) or [((), 0.0)]
+        for key, v in cells:
+            out.append(f"{self.name}{_fmt_labels(key)} {_fmt_value(v)}")
+        return out
+
+
+class Histogram:
+    """Fixed-bucket histogram. Buckets are upper bounds (seconds for the
+    latency families); observations land in every bucket whose bound is
+    >= the value — the cumulative Prometheus semantics are computed at
+    render so the hot path is one bisect + two adds."""
+
+    kind = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        help: str = "",
+        buckets: Sequence[float] = DEFAULT_BUCKETS,
+    ):
+        self.name = name
+        self.help = help
+        self.buckets: Tuple[float, ...] = tuple(sorted(float(b) for b in buckets))
+        self._lock = threading.Lock()
+        # per label-set: ([per-bucket non-cumulative counts] + [overflow],
+        #                 sum, count)
+        self._cells: Dict[LabelKey, List] = {}
+
+    def observe(self, value: float, **labels: str) -> None:
+        import bisect
+
+        value = float(value)
+        key = _label_key(labels)
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = [[0] * (len(self.buckets) + 1), 0.0, 0]
+                self._cells[key] = cell
+            cell[0][i] += 1
+            cell[1] += value
+            cell[2] += 1
+
+    def count(self, **labels: str) -> int:
+        with self._lock:
+            cell = self._cells.get(_label_key(labels))
+            return cell[2] if cell else 0
+
+    def sum(self, **labels: str) -> float:
+        with self._lock:
+            cell = self._cells.get(_label_key(labels))
+            return cell[1] if cell else 0.0
+
+    def render(self) -> List[str]:
+        out = [
+            f"# HELP {self.name} {_escape_help(self.help)}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            cells = {
+                key: ([*counts], s, c)
+                for key, (counts, s, c) in sorted(self._cells.items())
+            } or {(): ([0] * (len(self.buckets) + 1), 0.0, 0)}
+        for key, (counts, total, n) in cells.items():
+            cum = 0
+            for bound, cnt in zip(self.buckets, counts):
+                cum += cnt
+                out.append(
+                    f"{self.name}_bucket"
+                    f"{_fmt_labels(key, ('le', _fmt_value(bound)))} {cum}"
+                )
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(key, ('le', '+Inf'))} {n}"
+            )
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {_fmt_value(total)}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+        return out
+
+
+class MetricsRegistry:
+    """Name -> metric. ``counter``/``gauge``/``histogram`` are
+    get-or-create (idempotent, so call sites need no registration
+    ceremony); re-registering with a different kind raises."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get_or_create(self, cls, name: str, help: str, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets: Sequence[float] = DEFAULT_BUCKETS
+    ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def render(self) -> str:
+        """Full Prometheus text exposition (v0.0.4), families in name order."""
+        with self._lock:
+            metrics = [self._metrics[k] for k in sorted(self._metrics)]
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+#: the process-global registry every runtime layer writes to
+REGISTRY = MetricsRegistry()

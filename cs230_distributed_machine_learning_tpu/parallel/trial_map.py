@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.base import ModelKernel, TrialData
+from ..obs import counter_inc, observe
 from ..ops.folds import SplitPlan
 from ..utils.aot_cache import aot_jit
 from .distributed import fetch as _fetch
@@ -38,6 +39,29 @@ from .distributed import prefetch_async
 from .mesh import pad_to_multiple
 
 _compiled_cache: Dict[Any, Any] = {}
+
+
+def _cache_count(hit: bool) -> None:
+    """In-process executable-cache accounting (obs catalog)."""
+    counter_inc(
+        "tpuml_executable_cache_hits_total"
+        if hit
+        else "tpuml_executable_cache_misses_total"
+    )
+
+
+class _PhaseAcc(threading.local):
+    """Per-thread phase-time accumulators for the current run_trials call:
+    stage (host->device uploads on cache miss) and fetch (blocking
+    device->host transfers). Thread-local because coordinator job threads
+    and cluster worker loops run trial batches concurrently."""
+
+    def __init__(self):
+        self.stage = 0.0
+        self.fetch = 0.0
+
+
+_PHASE = _PhaseAcc()
 
 
 def _sds(a):
@@ -140,15 +164,23 @@ def _fetch_result(out, spec: Optional[_PackSpec]):
     Packed results (``spec`` given, or ``out`` already a ``_Packed``) cross
     the link as ONE buffer via a single device_get; unpacked dicts pay one
     conversion per leaf — and under a multi-process mesh go through the
-    collective fetch."""
+    collective fetch. Each blocking fetch feeds the
+    ``tpuml_executor_fetch_seconds`` histogram and the per-run phase
+    accumulator (TrialRunResult.fetch_time_s)."""
+    t0 = time.perf_counter()
     if isinstance(out, _Packed):
         out, spec = out.buf, out.spec
     if spec is not None:
         buf = np.asarray(jax.device_get(out))
-        return _unpack(buf, spec), 1, buf.nbytes
-    host = _fetch(out)
-    leaves = jax.tree_util.tree_leaves(host)
-    return host, len(leaves), sum(int(l.nbytes) for l in leaves)
+        result = _unpack(buf, spec), 1, buf.nbytes
+    else:
+        host = _fetch(out)
+        leaves = jax.tree_util.tree_leaves(host)
+        result = host, len(leaves), sum(int(l.nbytes) for l in leaves)
+    dt = time.perf_counter() - t0
+    observe("tpuml_executor_fetch_seconds", dt)
+    _PHASE.fetch += dt
+    return result
 
 
 # ---- compressed staging uploads -------------------------------------------
@@ -313,7 +345,12 @@ def _staged_device(data, key, make):
     # unlike a concurrent LRU eviction between insert and a re-read, which
     # would KeyError. The local `val` is returned directly so eviction of
     # this key by another thread can never fail THIS call.
+    t0 = time.perf_counter()
     val = make()
+    dt = time.perf_counter() - t0
+    # only misses are observed: a cache hit is not a staging upload
+    observe("tpuml_executor_stage_seconds", dt)
+    _PHASE.stage += dt
     if cache is not None:
         with _STAGED_LOCK:
             cache[key] = val
@@ -383,6 +420,10 @@ class TrialRunResult:
     n_host_fetches: int = 0
     #: bytes crossing the device->host boundary in those fetches
     result_bytes: int = 0
+    #: wall seconds in host->device staging uploads (cache misses only)
+    stage_time_s: float = 0.0
+    #: wall seconds in blocking device->host result fetches
+    fetch_time_s: float = 0.0
 
 
 def run_trials(
@@ -417,6 +458,10 @@ def run_trials(
     dispatches = 0
     n_fetches = 0
     result_bytes = 0
+    # phase accumulators for THIS call (thread-local: concurrent jobs in
+    # other threads keep their own) — read back into the TrialRunResult
+    _PHASE.stage = 0.0
+    _PHASE.fetch = 0.0
     # dispatches are queued without blocking and drained at the end: on a
     # remote/tunneled device each round trip costs ~0.25 s of latency, so a
     # multi-bucket job (e.g. a grid over a static param) overlaps its RPCs
@@ -641,6 +686,7 @@ def run_trials(
                 kernel, static, X, data.n_classes, plan.n_splits, chunk, hyper_names
             )
             fresh_compile = cache_key not in _compiled_cache
+            _cache_count(not fresh_compile)
             if fresh_compile:
                 raw = _make_batched(kernel, static, bool(hyper_names))
                 spec = None
@@ -686,6 +732,7 @@ def run_trials(
                 hyper_names, stage_mode=stage_mode,
             )
             fresh_compile = cache_key not in _compiled_cache
+            _cache_count(not fresh_compile)
             if fresh_compile:
                 raw = batched_fn
                 if stage_mode != "f32":
@@ -783,6 +830,8 @@ def run_trials(
                         # time is steady run time, not compile
                         out_g = jax.block_until_ready(out_g)
                         compile_time += time.perf_counter() - t0
+                        observe("tpuml_executor_compile_seconds",
+                                time.perf_counter() - t0)
                     if out_spec is not None:
                         out_g = _Packed(out_g, out_spec)
                     group_outs.append((out_g, size))
@@ -794,6 +843,8 @@ def run_trials(
                 # XLA compile is attributed; steady-state dispatches queue
                 out = jax.block_until_ready(out)
                 compile_time += time.perf_counter() - t0
+                observe("tpuml_executor_compile_seconds",
+                        time.perf_counter() - t0)
             if out_spec is not None:
                 out = _Packed(out, out_spec)
             if mesh is not None and n_dev > 1:
@@ -817,6 +868,8 @@ def run_trials(
         device_best=device_best,
         n_host_fetches=n_fetches,
         result_bytes=result_bytes,
+        stage_time_s=_PHASE.stage,
+        fetch_time_s=_PHASE.fetch,
     )
 
 
@@ -866,6 +919,7 @@ def fit_single(
     if chunk_plan:
         n_chunks = int(chunk_plan["n_chunks"])
         ck = fit_key + ("chunked", n_chunks, chunk_plan["trees_per_chunk"])
+        _cache_count(ck in _compiled_cache)
         if ck not in _compiled_cache:
             _compiled_cache[ck] = (
                 jax.jit(lambda X, y, w, h: kernel.chunk_init(X, y, w, h, static)),
@@ -891,6 +945,7 @@ def fit_single(
         fitted = kernel.assemble_artifact(trees, X, hyper_arg, static, y, w)
         return jax.tree_util.tree_map(np.asarray, fitted), static
 
+    _cache_count(fit_key in _compiled_cache)
     if fit_key not in _compiled_cache:
         _compiled_cache[fit_key] = jax.jit(
             lambda X, y, w, h: kernel.fit(X, y, w, h, static)
@@ -1053,8 +1108,10 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
         _mesh_signature(mesh),
     )
     if cache_key in _compiled_cache:
+        _cache_count(True)
         fn, spec = _compiled_cache[cache_key]
         return fn, spec, False
+    _cache_count(False)
 
     batched = _make_batched(kernel, static, has_hyper)
     if stage_mode != "f32":
@@ -1213,6 +1270,7 @@ def _run_chunked(
     result_bytes = 0
     device_best = None
     fresh = cache_tag not in _compiled_cache
+    _cache_count(not fresh)
     if fresh:
         # compile_time counts executable construction (trace or AOT
         # deserialize) only — the first batch's wall time is real chunked
@@ -1278,6 +1336,7 @@ def _run_chunked(
             )
         _compiled_cache[cache_tag] = (fi, fs, fe, fe_spec)
         compile_time += time.perf_counter() - t_build
+        observe("tpuml_executor_compile_seconds", compile_time)
     fi, fs, fe, fe_spec = _compiled_cache[cache_tag]
 
     for start in range(0, len(idxs), chunk):

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..obs import TRACE_HEADER, Tracer, obs_enabled, span, use_tracer
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
@@ -84,6 +85,12 @@ class WorkerAgent:
         self.worker_id = self._register(mem_capacity_mb, register_retries, register_backoff_s)
         self.executor = _make_executor(self.url, self.worker_id, mesh, max_batch)
         self._threads: List[threading.Thread] = []
+        # spans recorded in THIS process (executor.batch + phases) go into a
+        # private tracer and ship to the coordinator after each batch
+        # (POST /trace_spans/<wid>), so one job's timeline stitches across
+        # the process boundary. journal=False: the coordinator journals on
+        # ingest — double-writing locally would split the record.
+        self._tracer = Tracer(pending=True, journal=False)
 
     # ---------------- lifecycle ----------------
 
@@ -169,19 +176,50 @@ class WorkerAgent:
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
+            t_poll = time.time()
             tasks = self._poll_tasks()
             if not tasks:
                 continue
+            tid = next((t.get("trace_id") for t in tasks if t.get("trace_id")), None)
+            if tid and obs_enabled():
+                # back-dated span over the long-poll that delivered the batch
+                with span("agent.poll", trace_id=tid, parent_id=None,
+                          tracer=self._tracer, worker=self.worker_id,
+                          n_tasks=len(tasks)) as sp:
+                    sp.start = t_poll
             try:
-                self.executor.run_subtasks(
-                    tasks,
-                    on_result=self._post_result,
-                    on_metrics=self._post_metrics,
-                )
+                with use_tracer(self._tracer):
+                    self.executor.run_subtasks(
+                        tasks,
+                        on_result=self._post_result,
+                        on_metrics=self._post_metrics,
+                    )
             except DeviceLostError:
                 _exit_for_restart(
                     f"Agent {self.worker_id} lost its device backend"
                 )
+            finally:
+                self._ship_spans()
+
+    def _ship_spans(self) -> None:
+        """Ship locally-recorded spans to the coordinator's tracer
+        (POST /trace_spans/<wid>, X-Trace-Id on the request) — the
+        return leg of the trace-header propagation contract. Best-effort:
+        a lost batch of spans degrades the timeline, never the job."""
+        spans = self._tracer.drain()
+        if not spans:
+            return
+        import requests
+
+        try:
+            requests.post(
+                f"{self.url}/trace_spans/{self.worker_id}",
+                json={"spans": json_safe(spans)},
+                headers={TRACE_HEADER: spans[0].get("trace_id", "")},
+                timeout=10,
+            )
+        except Exception:  # noqa: BLE001
+            logger.warning("Span shipping failed (%d spans dropped)", len(spans))
 
     def _post_result(self, stid: str, status: str, result: Optional[Dict[str, Any]]) -> None:
         import requests
@@ -453,9 +491,20 @@ def run_distributed(
             if not tasks:
                 continue
             try:
-                executor.run_subtasks(
-                    tasks, on_result=post_result, on_metrics=post_metrics
-                )
+                if agent is not None:
+                    # primary: route spans into the agent's tracer and ship
+                    # them after the batch (non-primaries record nothing —
+                    # their work is the same lockstep program)
+                    with use_tracer(agent._tracer):
+                        executor.run_subtasks(
+                            tasks, on_result=post_result,
+                            on_metrics=post_metrics,
+                        )
+                    agent._ship_spans()
+                else:
+                    executor.run_subtasks(
+                        tasks, on_result=post_result, on_metrics=post_metrics
+                    )
             except DeviceLostError:
                 _exit_for_restart(
                     f"SPMD rank {jax.process_index()} lost its backend"

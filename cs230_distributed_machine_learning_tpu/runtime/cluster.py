@@ -24,6 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..obs import counter_inc, observe
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .executor import DeviceLostError, LocalExecutor
@@ -182,6 +183,7 @@ class ClusterRuntime:
         sub = self._remote_subs.get(worker_id)
         if sub is None:
             raise KeyError(f"Unknown remote worker {worker_id}")
+        counter_inc("tpuml_agent_polls_total")
         tasks: List[Dict[str, Any]] = []
         try:
             tasks.append(sub.get(timeout=timeout_s)[1])
@@ -192,12 +194,40 @@ class ClusterRuntime:
                 tasks.append(sub.get_nowait()[1])
             except _queue.Empty:
                 break
+        if tasks:
+            counter_inc("tpuml_agent_tasks_pulled_total", len(tasks))
         return tasks
 
     def push_result(self, worker_id: str, result: Dict[str, Any]) -> None:
+        counter_inc("tpuml_agent_acks_total")
+        # REMOTE agents only reach this path (in-process workers publish to
+        # the bus directly and their executor already counted locally):
+        # count the outcome coordinator-side so /metrics/prom sees subtasks
+        # executed in other processes too
+        counter_inc(
+            "tpuml_subtasks_failed_total"
+            if (result or {}).get("status") == "failed"
+            else "tpuml_subtasks_completed_total"
+        )
         self.bus.publish(TOPIC_RESULT, result, key=result.get("subtask_id"))
 
     def push_metrics(self, worker_id: str, msg: Dict[str, Any]) -> None:
+        # remote executor phase timers -> the coordinator's histograms.
+        # Agents' registries live in their own processes with no exposition
+        # endpoint, so the batch totals ride the metrics message instead;
+        # batch_primary marks exactly one message per batch (dedup). An
+        # in-test agent sharing this process double-observes into the same
+        # registry — cosmetic there, absent in real multi-process fleets.
+        if msg.get("batch_primary"):
+            for field, metric in (
+                ("batch_compile_s", "tpuml_executor_compile_seconds"),
+                ("batch_stage_s", "tpuml_executor_stage_seconds"),
+                ("batch_dispatch_s", "tpuml_executor_dispatch_seconds"),
+                ("batch_fetch_s", "tpuml_executor_fetch_seconds"),
+            ):
+                v = msg.get(field)
+                if isinstance(v, (int, float)):
+                    observe(metric, float(v))
         self.bus.publish(
             TOPIC_METRICS, {**msg, "worker_id": worker_id}, key=msg.get("subtask_id")
         )

@@ -20,6 +20,7 @@ from typing import Any, Dict, List, Optional
 from ..data.datasets import DatasetCache
 from ..data.download import download_dataset
 from ..data.preprocess import preprocess_dataframe
+from ..obs import TRACER, activate, counter_inc, current_trace_id, new_trace_id, span
 from ..utils.config import FrameworkConfig, get_config
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
@@ -187,12 +188,28 @@ class Coordinator:
                 "use a scorer name, or a coordinator without a cluster"
             )
 
-        subtasks = create_subtasks(job_id, sid, dataset_id, model_details, train_params)
-        try:
-            metadata = self.cache.metadata(dataset_id)
-        except FileNotFoundError:
-            metadata = {}
-        self.store.create_job(sid, job_id, payload, subtasks, metadata)
+        # one trace id per job, minted here unless the client already sent
+        # one (X-Trace-Id via the REST server, or an activate() in local
+        # mode); stamped into every subtask spec so it rides the task bus /
+        # /next_tasks long-poll to remote agents (docs/OBSERVABILITY.md)
+        trace_id = current_trace_id() or new_trace_id()
+        TRACER.bind_job(job_id, trace_id)
+        with span("job.submit", trace_id=trace_id, job_id=job_id,
+                  dataset_id=dataset_id,
+                  model_type=model_details.get("model_type")) as sub_sp:
+            with span("job.expand", job_id=job_id):
+                subtasks = create_subtasks(
+                    job_id, sid, dataset_id, model_details, train_params
+                )
+            for st in subtasks:
+                st["trace_id"] = trace_id
+            sub_sp.attrs["total_subtasks"] = len(subtasks)
+            try:
+                metadata = self.cache.metadata(dataset_id)
+            except FileNotFoundError:
+                metadata = {}
+            self.store.create_job(sid, job_id, payload, subtasks, metadata)
+        counter_inc("tpuml_jobs_submitted_total")
 
         t = threading.Thread(
             target=self._run_job, args=(sid, job_id, subtasks), daemon=True
@@ -225,22 +242,40 @@ class Coordinator:
 
         existing = existing or {}
         remaining = [st for st in subtasks if st["subtask_id"] not in existing]
+        # job threads start with an empty contextvar context: re-activate the
+        # trace the subtask specs carry (journaled specs keep it across a
+        # coordinator restart, so resumed jobs stitch into the same trace)
+        trace_id = next(
+            (st.get("trace_id") for st in subtasks if st.get("trace_id")), None
+        ) or TRACER.trace_for_job(job_id) or new_trace_id()
+        TRACER.bind_job(job_id, trace_id)
         try:
-            if not remaining:
-                new_results: List[Dict[str, Any]] = []
-            elif self.cluster is not None:
-                new_results = self._run_job_scheduled(sid, job_id, remaining, on_result)
-            else:
-                new_results = self.executor.run_subtasks(
-                    remaining, on_result=on_result, on_metrics=on_metrics
-                )
-            by_id = dict(existing)
-            for st, r in zip(remaining, new_results):
-                by_id[st["subtask_id"]] = r
-            results = [by_id.get(st["subtask_id"]) for st in subtasks]
-            self._aggregate(sid, job_id, subtasks, results)
+            with activate(trace_id):
+                with span("job.execute", trace_id=trace_id, job_id=job_id,
+                          n_subtasks=len(remaining),
+                          n_resumed=len(existing),
+                          mode="scheduled" if self.cluster is not None
+                          else "direct"):
+                    if not remaining:
+                        new_results: List[Dict[str, Any]] = []
+                    elif self.cluster is not None:
+                        new_results = self._run_job_scheduled(
+                            sid, job_id, remaining, on_result
+                        )
+                    else:
+                        new_results = self.executor.run_subtasks(
+                            remaining, on_result=on_result, on_metrics=on_metrics
+                        )
+                by_id = dict(existing)
+                for st, r in zip(remaining, new_results):
+                    by_id[st["subtask_id"]] = r
+                results = [by_id.get(st["subtask_id"]) for st in subtasks]
+                with span("job.aggregate", trace_id=trace_id, job_id=job_id):
+                    self._aggregate(sid, job_id, subtasks, results)
+            counter_inc("tpuml_jobs_completed_total")
         except Exception as e:  # noqa: BLE001
             logger.exception("Job %s failed", job_id)
+            counter_inc("tpuml_jobs_failed_total")
             self.store.finalize_job(
                 sid, job_id, {"status": "failed", "error": str(e)}
             )

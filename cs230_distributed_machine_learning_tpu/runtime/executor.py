@@ -13,12 +13,14 @@ engine's runtime predictor) are drop-in.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..data.datasets import DatasetCache
 from ..models.registry import get_kernel
+from ..obs import counter_inc, observe, record_phase, span
 from ..ops.folds import build_split_plan
 from ..parallel.trial_map import fit_single, run_trials
 from ..utils.config import get_config
@@ -152,91 +154,35 @@ class LocalExecutor:
 
         for (dataset_id, model_type), idxs in groups.items():
             received_at = time.time()
+            # the batch rides the submitting job's trace (trace_id stamped
+            # into each subtask spec by the coordinator); direct callers
+            # (benchmarks) carry none — then no span is opened at all
+            tid = next(
+                (
+                    subtasks[i].get("trace_id")
+                    for i in idxs
+                    if subtasks[i].get("trace_id")
+                ),
+                None,
+            )
+            batch_cm = (
+                span(
+                    "executor.batch",
+                    trace_id=tid,
+                    worker=self.executor_id,
+                    model_type=model_type,
+                    dataset_id=dataset_id,
+                    n_subtasks=len(idxs),
+                )
+                if tid
+                else contextlib.nullcontext(None)
+            )
             try:
-                if self.fault_injector is not None:
-                    self.fault_injector.before_batch(self.executor_id, model_type)
-                kernel = get_kernel(model_type)
-                data = self.cache.get(dataset_id, kernel.task)
-                tp = subtasks[idxs[0]].get("train_params", {}) or {}
-                scoring = _normalize_scoring(
-                    tp.get("scoring"), kernel.task, data.n_classes, kernel
-                )
-                plan = build_split_plan(
-                    data.y if kernel.task == "regression" else _np(data.y),
-                    task=kernel.task,
-                    n_folds=_coerce_cv(tp.get("cv")),
-                    test_size=float(tp.get("test_size", get_config().execution.default_test_size)),
-                    random_state=tp.get("random_state", 42),
-                )
-                started_at = time.time()
-                profiler_cm = self._profiler_cm(model_type)
-                with profiler_cm, ResourceSampler() as sampler:
-                    if callable(scoring) and not isinstance(scoring, str):
-                        # host-side fallback: device fits per fold, sklearn
-                        # export, user scorer on host (trial_map docstring)
-                        from ..parallel.trial_map import (
-                            TrialRunResult,
-                            run_trials_callable,
-                        )
-
-                        t0 = time.time()
-                        metrics_list = run_trials_callable(
-                            kernel, data, plan,
-                            [subtasks[i]["parameters"] for i in idxs],
-                            scoring,
-                        )
-                        run = TrialRunResult(
-                            trial_metrics=metrics_list,
-                            compile_time_s=0.0,
-                            run_time_s=time.time() - t0,
-                            n_dispatches=len(idxs) * plan.n_splits,
-                        )
-                    else:
-                        run = run_trials(
-                            kernel,
-                            data,
-                            plan,
-                            [subtasks[i]["parameters"] for i in idxs],
-                            mesh=self.mesh,
-                            trial_axis=self.trial_axis,
-                            max_trials_per_batch=self.max_trials_per_batch,
-                            scoring=scoring,
-                        )
-                finished_at = time.time()
-                resources = sampler.averages()
-                per_trial_time = run.run_time_s / max(len(idxs), 1)
-                # winner-by-ICI-collective: run_trials' on-device argmax over
-                # the mesh-sharded scores (multi-device only). The marked
-                # result lets the coordinator select the winner from the
-                # device reduction instead of a host sort.
-                device_best_pos = (
-                    run.device_best[0] if run.device_best is not None else None
-                )
-                for j, gi in enumerate(idxs):
-                    st = subtasks[gi]
-                    result = {
-                        "subtask_id": st["subtask_id"],
-                        "job_id": st.get("job_id"),
-                        "model_type": model_type,
-                        "parameters": st["parameters"],
-                        "search_params": st.get("search_params"),
-                        "training_time": per_trial_time,
-                        "status": "completed",
-                        **run.trial_metrics[j],
-                    }
-                    if device_best_pos == j:
-                        result["device_argmax"] = True
-                    results[gi] = result
-                    if on_result:
-                        on_result(st["subtask_id"], "completed", result)
-                    if on_metrics:
-                        on_metrics(
-                            self._metrics_message(
-                                st, received_at, started_at, finished_at,
-                                model_type, resources, run=run,
-                                batch_size=len(idxs),
-                            )
-                        )
+                with batch_cm as batch_sp:
+                    self._run_group(
+                        subtasks, idxs, dataset_id, model_type, received_at,
+                        results, on_result, on_metrics, batch_sp,
+                    )
             except Exception as e:  # noqa: BLE001 — task-level failure semantics
                 if _is_device_fatal(e):
                     # a poisoned backend fails every later dispatch in this
@@ -258,9 +204,135 @@ class LocalExecutor:
                         "error": str(e),
                     }
                     results[gi] = result
+                    counter_inc("tpuml_subtasks_failed_total")
                     if on_result:
                         on_result(st["subtask_id"], "failed", result)
         return results  # type: ignore[return-value]
+
+    def _run_group(
+        self, subtasks, idxs, dataset_id, model_type, received_at,
+        results, on_result, on_metrics, batch_sp,
+    ) -> None:
+        """Execute one (dataset, model_type) group on the trial engine and
+        emit per-subtask results/metrics. ``batch_sp`` is the enclosing
+        ``executor.batch`` span handle (or None): the engine's phase timers
+        — compile / stage-upload / dispatch / packed fetch, the numbers
+        PR 1 measured ad-hoc — are attached to it as synthesized child
+        spans laid out sequentially from batch start."""
+        if self.fault_injector is not None:
+            self.fault_injector.before_batch(self.executor_id, model_type)
+        kernel = get_kernel(model_type)
+        data = self.cache.get(dataset_id, kernel.task)
+        tp = subtasks[idxs[0]].get("train_params", {}) or {}
+        scoring = _normalize_scoring(
+            tp.get("scoring"), kernel.task, data.n_classes, kernel
+        )
+        plan = build_split_plan(
+            data.y if kernel.task == "regression" else _np(data.y),
+            task=kernel.task,
+            n_folds=_coerce_cv(tp.get("cv")),
+            test_size=float(tp.get("test_size", get_config().execution.default_test_size)),
+            random_state=tp.get("random_state", 42),
+        )
+        started_at = time.time()
+        profiler_cm = self._profiler_cm(model_type)
+        with profiler_cm, ResourceSampler() as sampler:
+            if callable(scoring) and not isinstance(scoring, str):
+                # host-side fallback: device fits per fold, sklearn
+                # export, user scorer on host (trial_map docstring)
+                from ..parallel.trial_map import (
+                    TrialRunResult,
+                    run_trials_callable,
+                )
+
+                t0 = time.time()
+                metrics_list = run_trials_callable(
+                    kernel, data, plan,
+                    [subtasks[i]["parameters"] for i in idxs],
+                    scoring,
+                )
+                run = TrialRunResult(
+                    trial_metrics=metrics_list,
+                    compile_time_s=0.0,
+                    run_time_s=time.time() - t0,
+                    n_dispatches=len(idxs) * plan.n_splits,
+                )
+            else:
+                run = run_trials(
+                    kernel,
+                    data,
+                    plan,
+                    [subtasks[i]["parameters"] for i in idxs],
+                    mesh=self.mesh,
+                    trial_axis=self.trial_axis,
+                    max_trials_per_batch=self.max_trials_per_batch,
+                    scoring=scoring,
+                )
+        finished_at = time.time()
+        observe("tpuml_executor_dispatch_seconds", run.run_time_s)
+        self._record_batch_phases(batch_sp, run, started_at)
+        resources = sampler.averages()
+        per_trial_time = run.run_time_s / max(len(idxs), 1)
+        # winner-by-ICI-collective: run_trials' on-device argmax over
+        # the mesh-sharded scores (multi-device only). The marked
+        # result lets the coordinator select the winner from the
+        # device reduction instead of a host sort.
+        device_best_pos = (
+            run.device_best[0] if run.device_best is not None else None
+        )
+        for j, gi in enumerate(idxs):
+            st = subtasks[gi]
+            result = {
+                "subtask_id": st["subtask_id"],
+                "job_id": st.get("job_id"),
+                "model_type": model_type,
+                "parameters": st["parameters"],
+                "search_params": st.get("search_params"),
+                "training_time": per_trial_time,
+                "status": "completed",
+                **run.trial_metrics[j],
+            }
+            if device_best_pos == j:
+                result["device_argmax"] = True
+            results[gi] = result
+            counter_inc("tpuml_subtasks_completed_total")
+            if on_result:
+                on_result(st["subtask_id"], "completed", result)
+            if on_metrics:
+                on_metrics(
+                    self._metrics_message(
+                        st, received_at, started_at, finished_at,
+                        model_type, resources, run=run,
+                        batch_size=len(idxs), primary=(j == 0),
+                    )
+                )
+
+    @staticmethod
+    def _record_batch_phases(batch_sp, run, started_at: float) -> None:
+        """Attach the trial engine's measured phase totals to the batch
+        span as synthesized children. Phases are laid out sequentially from
+        batch start (real execution overlaps stage/dispatch/fetch — the
+        durations are exact, the offsets indicative; attrs carry
+        ``synthesized: true``)."""
+        if batch_sp is None or getattr(batch_sp, "span_id", None) is None:
+            return
+        batch_sp.attrs.update(
+            n_dispatches=run.n_dispatches,
+            n_host_fetches=run.n_host_fetches,
+            result_bytes=run.result_bytes,
+            compile_time_s=round(run.compile_time_s, 6),
+            run_time_s=round(run.run_time_s, 6),
+        )
+        t = record_phase(
+            batch_sp, "executor.compile", run.compile_time_s, start=started_at
+        )
+        t = record_phase(batch_sp, "executor.stage", run.stage_time_s, start=t)
+        dispatch_s = max(run.run_time_s - run.fetch_time_s, 0.0)
+        t = record_phase(batch_sp, "executor.dispatch", dispatch_s, start=t,
+                         n_dispatches=run.n_dispatches)
+        record_phase(batch_sp, "executor.fetch", run.fetch_time_s, start=t,
+                     n_host_fetches=run.n_host_fetches,
+                     result_bytes=run.result_bytes)
 
     def fit_artifact(self, subtask: Dict[str, Any]) -> Dict[str, Any]:
         """Refit one configuration on the holdout-train split and return a
@@ -284,7 +356,8 @@ class LocalExecutor:
         }
 
     def _metrics_message(self, st, received_at, started_at, finished_at,
-                         algo, resources=None, run=None, batch_size=1):
+                         algo, resources=None, run=None, batch_size=1,
+                         primary=False):
         """Reference metrics schema (worker.py:233-243): CPU/mem averaged
         over the fit by the 0.5 s-cadence ResourceSampler (the predictor's
         feature inputs), plus device peak-memory — the accelerator signal
@@ -309,11 +382,19 @@ class LocalExecutor:
             # batch this subtask rode in (every subtask of the batch
             # carries the same numbers — summing them per job would
             # overcount by the batch size; divide by batch_n_subtasks or
-            # dedupe on them instead)
+            # dedupe on them instead). ``batch_primary`` marks exactly one
+            # message per batch — the dedup handle consumers (e.g. the
+            # coordinator's remote-metrics ingest, cluster.push_metrics)
+            # key batch-level observations on.
             msg["batch_n_subtasks"] = batch_size
             msg["batch_n_dispatches"] = run.n_dispatches
             msg["batch_device_fetches"] = run.n_host_fetches
             msg["batch_result_bytes"] = run.result_bytes
+            msg["batch_primary"] = bool(primary)
+            msg["batch_compile_s"] = run.compile_time_s
+            msg["batch_stage_s"] = run.stage_time_s
+            msg["batch_dispatch_s"] = run.run_time_s
+            msg["batch_fetch_s"] = run.fetch_time_s
         return msg
 
 

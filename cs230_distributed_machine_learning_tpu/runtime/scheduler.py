@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs import counter_inc, gauge_set, observe, span
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .predictor import RuntimePredictor
@@ -82,12 +83,14 @@ class PlacementEngine:
                 mem_capacity_mb=mem_capacity_mb or self.cfg.default_mem_capacity_mb,
             )
             logger.info("Worker %s subscribed", worker_id)
+            gauge_set("tpuml_workers_alive", len(self.workers))
             return worker_id
 
     def unsubscribe(self, worker_id: str) -> List[Dict[str, Any]]:
         """Remove a worker; requeue its queued tasks. Returns the requeued tasks."""
         with self._lock:
             state = self.workers.pop(worker_id, None)
+            gauge_set("tpuml_workers_alive", len(self.workers))
         if state is None:
             return []
         logger.info("Worker %s unsubscribed; requeueing %d tasks", worker_id, len(state.tasks_queue))
@@ -127,7 +130,10 @@ class PlacementEngine:
     def place(self, task: Dict[str, Any]) -> Optional[str]:
         """Choose a worker for a task, update its load, and (when a bus is
         wired) publish to the train topic keyed by worker id. Returns the
-        worker id, or None if no workers exist."""
+        worker id, or None if no workers exist. The decision latency feeds
+        the ``tpuml_scheduler_placement_seconds`` histogram and, when the
+        task carries a trace id, a ``schedule.place`` span."""
+        t_place = time.perf_counter()
         est = self.predictor.predict(task)
         mem_mb = float(task.get("mem_estimate_mb", 1.0))
         with self._lock:
@@ -156,6 +162,15 @@ class PlacementEngine:
             best.task_est[stid] = est
             best.task_mem[stid] = mem_mb
             wid = best.worker_id
+        elapsed = time.perf_counter() - t_place
+        observe("tpuml_scheduler_placement_seconds", elapsed)
+        counter_inc("tpuml_subtasks_dispatched_total")
+        tid = task.get("trace_id")
+        if tid:
+            # the decision already ran: back-date the span over it
+            with span("schedule.place", trace_id=tid, parent_id=None,
+                      subtask_id=stid, worker=wid, est_runtime_s=est) as sp:
+                sp.start = time.time() - elapsed
         if self.bus is not None:
             self.bus.publish(TOPIC_TRAIN, task, key=wid)
         return wid
@@ -216,6 +231,8 @@ class PlacementEngine:
             for wid, w in list(self.workers.items()):
                 if now - w.last_heartbeat > self.cfg.dead_after_s:
                     dead.append(self.workers.pop(wid))
+            if dead:
+                gauge_set("tpuml_workers_alive", len(self.workers))
         for w in dead:
             logger.warning(
                 "Worker %s dead (no heartbeat for >%ss); requeueing %d tasks",
@@ -236,6 +253,7 @@ class PlacementEngine:
     def _requeue(self, tasks: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         requeued = []
         for task in tasks:
+            counter_inc("tpuml_subtasks_requeued_total")
             wid = self.place(task)
             if wid is None:
                 logger.error(

@@ -17,6 +17,16 @@ from __future__ import annotations
 import json
 from typing import Optional
 
+from ..obs import (
+    TRACE_HEADER,
+    TRACER,
+    activate,
+    counter_inc,
+    gauge_set,
+    obs_enabled,
+    render_prometheus,
+    span,
+)
 from ..utils.serialization import json_safe
 from .coordinator import Coordinator
 
@@ -35,15 +45,21 @@ _DASHBOARD_HTML = """<!doctype html>
 </style></head><body>
 <h1>tpuml coordinator</h1>
 <div id="meta">health: <span id="health">…</span> · refreshed <span id="ts">never</span>
- · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code></div>
+ · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code>
+ <code>/metrics/prom</code> <code>/trace/&lt;job_id&gt;</code></div>
 <h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
 <th>status</th><th>done</th><th>failed</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
+<h2>Latest job trace</h2>
+<div id="trace" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no trace yet</div>
 <h2>Workers</h2><table id="workers"><thead></thead><tbody></tbody></table>
 <h2>Queues</h2><table id="queues"><thead></thead><tbody></tbody></table>
 <h2>Supervised agents</h2><table id="sup"><thead></thead><tbody></tbody></table>
 <script>
 const get = u => fetch(u).then(r => r.ok ? r.json() : null).catch(() => null);
-const esc = s => String(s ?? "").replace(/[&<>]/g, c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+// quotes escaped too: esc() output lands inside attribute values (the
+// trace rows' title tooltips), and attrs carry client-controlled strings
+const esc = s => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
 // cell renderer: arrays (e.g. a worker's queued-subtask list) collapse to
 // a count + sample, never one column per index
 const cell = v => Array.isArray(v)
@@ -70,6 +86,32 @@ function listTable(el, arr){
   el.tBodies[0].innerHTML = arr.map(r =>
     "<tr>" + cols.map(c => `<td>${esc(JSON.stringify(r[c]))}</td>`).join("") + "</tr>").join("");
 }
+// span-tree timeline: one row per span, bar offset/width proportional to
+// [start, end] within the trace window, indented by tree depth
+function renderTrace(el, data){
+  if (!data || !data.spans || !data.spans.length){ el.textContent = "no trace yet"; return; }
+  const t0 = Math.min(...data.spans.map(s => s.start));
+  const t1 = Math.max(...data.spans.map(s => s.end));
+  const total = Math.max(t1 - t0, 1e-6);
+  const rows = [];
+  const walk = (nodes, depth) => (nodes || []).forEach(n => {
+    rows.push({n, depth}); walk(n.children, depth + 1); });
+  walk(data.tree, 0);
+  el.innerHTML =
+    `<div style="color:#666">trace <code>${esc(data.trace_id)}</code> · ` +
+    `${data.spans.length} spans · ${(total * 1000).toFixed(1)} ms</div>` +
+    rows.map(({n, depth}) => {
+      const off = 100 * (n.start - t0) / total;
+      const w = Math.max(100 * (n.end - n.start) / total, 0.4);
+      return `<div style="display:flex;align-items:center;margin:1px 0">` +
+        `<span style="width:230px;padding-left:${depth * 12}px;overflow:hidden;` +
+        `white-space:nowrap" title="${esc(JSON.stringify(n.attrs))}">${esc(n.name)}</span>` +
+        `<span style="flex:1;position:relative;height:10px;background:#f4f4f4">` +
+        `<span style="position:absolute;left:${off}%;width:${w}%;height:10px;` +
+        `background:${n.attrs && n.attrs.synthesized ? "#9bb8d3" : "#4a7fb5"}"></span></span>` +
+        `<span style="width:80px;text-align:right">${((n.end - n.start) * 1000).toFixed(1)} ms</span></div>`;
+    }).join("");
+}
 async function tick(){
   const [h, jobs, workers, queues, sup] = await Promise.all(
     ["/health", "/jobs", "/workers", "/queues", "/supervisor"].map(get));
@@ -86,6 +128,9 @@ async function tick(){
   kvTable(document.getElementById("workers"), workers);
   kvTable(document.getElementById("queues"), queues);
   listTable(document.getElementById("sup"), sup);
+  const latest = Array.isArray(jobs) && jobs.length ? jobs[0].job_id : null;
+  renderTrace(document.getElementById("trace"),
+              latest ? await get(`/trace/${latest}`) : null);
   document.getElementById("ts").textContent = new Date().toLocaleTimeString();
 }
 tick(); setInterval(tick, 2000);
@@ -121,6 +166,12 @@ def create_app(coordinator: Optional[Coordinator] = None):
             # the JSON introspection endpoints + a flat job feed
             Rule("/jobs", endpoint="jobs", methods=["GET"]),
             Rule("/dashboard", endpoint="dashboard", methods=["GET"]),
+            # observability plane (docs/OBSERVABILITY.md): Prometheus
+            # exposition of the unified metrics registry, per-job span
+            # trees, and the agents' span-shipping ingest
+            Rule("/metrics/prom", endpoint="metrics_prom", methods=["GET"]),
+            Rule("/trace/<jid>", endpoint="trace", methods=["GET"]),
+            Rule("/trace_spans/<wid>", endpoint="trace_spans", methods=["POST"]),
             # worker-agent control plane (reference scheduler.py:95-159)
             Rule("/subscribe", endpoint="subscribe", methods=["POST"]),
             Rule("/unsubscribe/<wid>", endpoint="unsubscribe", methods=["POST"]),
@@ -171,6 +222,8 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /queues",
                     "GET  /jobs",
                     "GET  /dashboard  (HTML)",
+                    "GET  /metrics/prom  (Prometheus exposition)",
+                    "GET  /trace/<job_id>  (span tree)",
                     "GET  /health",
                 ],
             }
@@ -225,7 +278,55 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return _json(coord.check_status(sid, jid))
 
     def metrics(request, sid, jid):
+        # ?wait=1: block until the job finalizes before replying — opt-in
+        # parity with the reference master's /metrics, which blocked until
+        # every subtask had reported (master.py:325-332). The default stays
+        # non-blocking (returns whatever has reported so far); see
+        # docs/API.md "Differences from the reference".
+        if request.args.get("wait"):
+            timeout = float(
+                request.args.get("timeout", coord.config.service.client_timeout_s)
+            )
+            coord._require_session(sid)
+            coord.store.wait_job(sid, jid, timeout)
         return _json(coord.job_metrics(sid, jid))
+
+    def metrics_prom(request):
+        # refresh point-in-time gauges at scrape time
+        if coord.cluster is not None:
+            gauge_set("tpuml_workers_alive", len(coord.cluster.engine.workers))
+        return Response(
+            render_prometheus(),
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def trace(request, jid):
+        tid = TRACER.trace_for_job(jid)
+        if tid is None:
+            return _json(
+                {"status": "error", "message": f"no trace for job {jid!r}"},
+                status=404,
+            )
+        spans = sorted(
+            TRACER.spans_for(tid), key=lambda s: (s.get("start") or 0)
+        )
+        return _json(
+            {
+                "job_id": jid,
+                "trace_id": tid,
+                "n_spans": len(spans),
+                "spans": spans,
+                "tree": TRACER.tree(tid),
+            }
+        )
+
+    def trace_spans(request, wid):
+        """Span-shipping ingest for remote agents (runtime/agent.py
+        _ship_spans): the return leg of the X-Trace-Id propagation."""
+        body = request.get_json(force=True, silent=True) or {}
+        n = TRACER.ingest(body.get("spans") or [])
+        counter_inc("tpuml_trace_spans_ingested_total", n)
+        return _json({"status": "ok", "ingested": n})
 
     def download_model(request, sid, jid):
         path = coord.best_model_path(sid, jid)
@@ -365,9 +466,23 @@ def create_app(coordinator: Optional[Coordinator] = None):
     def app(request):
         if request.method == "OPTIONS":
             return Response(status=204, headers=_cors)
+        # trace middleware: an inbound X-Trace-Id activates that trace for
+        # the handler (contextvar), so spans opened inside — including the
+        # coordinator's job.submit — join the CLIENT's trace; the id is
+        # echoed on the response. Untraced requests open no span at all
+        # (a /health poll must not mint garbage traces).
+        trace_id = request.headers.get(TRACE_HEADER)
         try:
             endpoint, values = url_map.bind_to_environ(request.environ).match()
-            resp = handlers[endpoint](request, **values)
+            counter_inc("tpuml_http_requests_total", endpoint=endpoint)
+            # trace_spans is the span TRANSPORT — tracing it would append
+            # one meta-span to every shipped batch's timeline
+            if trace_id and endpoint != "trace_spans" and obs_enabled():
+                with activate(trace_id):
+                    with span(f"http.{endpoint}", trace_id=trace_id):
+                        resp = handlers[endpoint](request, **values)
+            else:
+                resp = handlers[endpoint](request, **values)
         except NotFound:
             resp = _json({"status": "error", "message": "not found"}, status=404)
         except HTTPException as e:
@@ -377,6 +492,8 @@ def create_app(coordinator: Optional[Coordinator] = None):
         except Exception as e:  # noqa: BLE001
             resp = _json({"status": "error", "message": str(e)}, status=500)
         resp.headers.extend(_cors)
+        if trace_id:
+            resp.headers[TRACE_HEADER] = trace_id
         return resp
 
     app.coordinator = coord

@@ -171,17 +171,21 @@ def aot_jit(fn, key_parts: Sequence[Any], example_args: Tuple[Any, ...]):
 
     from jax import export as jex
 
+    from ..obs import counter_inc
+
     path = _blob_path(key_parts)
     if os.path.exists(path):
         try:
             with open(path, "rb") as f:
                 exp = jex.deserialize(f.read())
+            counter_inc("tpuml_aot_cache_hits_total")
             return jax.jit(exp.call), "aot"
         except Exception:  # noqa: BLE001 — stale/corrupt blob: re-trace
             try:
                 os.remove(path)
             except OSError:
                 pass
+    counter_inc("tpuml_aot_cache_misses_total")
 
     try:
         # Pallas kernels lower to Mosaic custom calls, which jax.export

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI gate, runnable locally or from .github/workflows/ci.yml:
+#   1. compileall lint gate — every .py in the package, tests, and
+#      benchmarks must byte-compile (catches syntax/indent rot with no
+#      deps beyond the stdlib);
+#   2. tier-1 fast suite — the ROADMAP.md verify command: pytest on the
+#      virtual 8-device CPU mesh, slow (subprocess/chaos/minutes-long)
+#      suites excluded.
+# Wall time of the fast suite on the dev box is recorded in
+# docs/STATUS.md; keep the two in sync when it moves.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== lint gate: python -m compileall =="
+python -m compileall -q cs230_distributed_machine_learning_tpu tests benchmarks
+
+echo "== tier-1 fast suite (JAX_PLATFORMS=cpu, -m 'not slow') =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider

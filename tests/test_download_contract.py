@@ -1,0 +1,102 @@
+"""Mocked kaggle / huggingface contract tests for data/download.py.
+
+The third-party ingestion paths can't run hermetically (no creds, no
+egress), so these tests PIN the call signatures instead: if the kaggle or
+``datasets`` client API we code against drifts — or a refactor changes
+what we pass — these fail without any network. Signature sources:
+``kaggle.api.dataset_download_files(dataset, path=, unzip=)`` and
+``datasets.load_dataset(path)`` -> ``DatasetDict[split].to_csv(path)``
+(the reference used the same calls, aws-prod/master/dataset_util.py:13-40).
+"""
+
+import os
+import sys
+import types
+
+import pytest
+
+from cs230_distributed_machine_learning_tpu.data.download import download_dataset
+
+
+@pytest.fixture()
+def fake_kaggle(monkeypatch):
+    """Install a recording stand-in for the ``kaggle`` package."""
+    calls = []
+    mod = types.ModuleType("kaggle")
+
+    class _Api:
+        @staticmethod
+        def dataset_download_files(dataset, path=None, unzip=None, **kwargs):
+            calls.append({"dataset": dataset, "path": path, "unzip": unzip,
+                          "extra": kwargs})
+
+    mod.api = _Api()
+    monkeypatch.setitem(sys.modules, "kaggle", mod)
+    return calls
+
+
+@pytest.fixture()
+def fake_hf(monkeypatch):
+    """Install a recording stand-in for ``datasets.load_dataset``."""
+    calls = {"load": [], "to_csv": []}
+
+    class _Split:
+        def to_csv(self, path):
+            calls["to_csv"].append(path)
+            with open(path, "w") as f:
+                f.write("a,b\n1,2\n")
+
+    def load_dataset(name):
+        calls["load"].append(name)
+        return {"train": _Split()}
+
+    mod = types.ModuleType("datasets")
+    mod.load_dataset = load_dataset
+    monkeypatch.setitem(sys.modules, "datasets", mod)
+    return calls
+
+
+def test_kaggle_call_signature_pinned(fake_kaggle, tmp_path):
+    target = download_dataset(
+        "some-user/some-dataset", "kag", "kaggle", root=str(tmp_path)
+    )
+    assert len(fake_kaggle) == 1
+    call = fake_kaggle[0]
+    # positional dataset slug, keyword path=target dir, unzip=True — the
+    # exact invocation dataset_util.py made and the kaggle client expects
+    assert call["dataset"] == "some-user/some-dataset"
+    assert call["path"] == target
+    assert call["unzip"] is True
+    assert call["extra"] == {}
+    assert os.path.isdir(target)
+
+
+def test_kaggle_missing_package_raises_runtime_error(monkeypatch, tmp_path):
+    monkeypatch.setitem(sys.modules, "kaggle", None)  # import -> ImportError
+    with pytest.raises(RuntimeError, match="kaggle package not available"):
+        download_dataset("u/d", "kag", "kaggle", root=str(tmp_path))
+
+
+def test_huggingface_call_signature_pinned(fake_hf, tmp_path):
+    target = download_dataset("org/corpus", "hfds", "huggingface", root=str(tmp_path))
+    # load_dataset called with the dataset path only
+    assert fake_hf["load"] == ["org/corpus"]
+    # first split exported to <target>/<name>.csv
+    assert fake_hf["to_csv"] == [os.path.join(target, "hfds.csv")]
+    assert os.path.exists(os.path.join(target, "hfds.csv"))
+
+
+def test_hf_alias_accepted(fake_hf, tmp_path):
+    download_dataset("org/corpus", "hfds2", "hf", root=str(tmp_path))
+    assert fake_hf["load"] == ["org/corpus"]
+
+
+def test_hf_missing_package_raises_runtime_error(monkeypatch, tmp_path):
+    monkeypatch.setitem(sys.modules, "datasets", None)
+    with pytest.raises(RuntimeError, match="huggingface datasets package"):
+        download_dataset("org/corpus", "hfds", "huggingface", root=str(tmp_path))
+
+
+def test_unknown_type_rejected(tmp_path):
+    with pytest.raises(ValueError, match="Unknown dataset_type"):
+        download_dataset("x", "y", "ftp", root=str(tmp_path))

@@ -134,6 +134,169 @@ def test_preprocess_endpoint(client, tmp_path):
     assert not df["a"].isna().any()
 
 
+def test_metrics_prom_trace_and_wait_on_one_job(client):
+    """One end-to-end local job exercises three observability surfaces:
+
+    1. ``GET /metrics/<sid>/<jid>?wait=1`` blocks until the job finalizes
+       (the reference master's blocking /metrics semantics,
+       master.py:325-332, as an opt-in) — no status polling needed;
+    2. ``GET /trace/<jid>`` returns the span tree under the X-Trace-Id
+       the client sent, covering submit -> expand -> execute -> batch
+       (+phases) -> aggregate;
+    3. ``GET /metrics/prom`` is parseable Prometheus text format including
+       the acceptance families — subtask counters, the placement
+       histogram, executor per-phase histograms, executable-cache
+       hit/miss counters.
+    """
+    import re
+
+    sid = _session(client)
+    tid = "feedc0de12345678"
+    resp = client.post(
+        f"/train/{sid}", json=_train_payload(sid), headers={"X-Trace-Id": tid}
+    )
+    assert resp.status_code == 200
+    jid = resp.get_json()["job_id"]
+
+    # (1) blocking wait=1: the call itself rides out the job
+    metrics = client.get(
+        f"/metrics/{sid}/{jid}", query_string={"wait": "1", "timeout": "120"}
+    ).get_json()
+    assert len(metrics) == 1
+    assert metrics[0]["status"] == "completed"
+
+    # (2) span tree under the client's trace id. The job thread records
+    # its job.execute/job.aggregate spans just AFTER finalize unblocks the
+    # wait above, so poll briefly for the full set (bounded, normally one
+    # iteration).
+    import time
+
+    required = {
+        "http.train", "job.submit", "job.expand", "job.execute",
+        "executor.batch", "job.aggregate",
+    }
+    deadline = time.time() + 10
+    while True:
+        body = client.get(f"/trace/{jid}").get_json()
+        names = {s["name"] for s in body["spans"]}
+        if required <= names or time.time() > deadline:
+            break
+        time.sleep(0.1)
+    assert required <= names, f"missing {sorted(required - names)}"
+    assert body["trace_id"] == tid
+    assert body["n_spans"] >= 5
+    assert all(s["trace_id"] == tid for s in body["spans"])
+    starts = [s["start"] for s in body["spans"]]
+    assert starts == sorted(starts)  # spans come back start-ordered
+    assert client.get("/trace/bogus").status_code == 404
+
+    # (3) full exposition parse
+    resp = client.get("/metrics/prom")
+    assert resp.status_code == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    text = resp.get_data(as_text=True)
+
+    # parse every line: HELP/TYPE pairs + samples, no junk
+    kinds = {}
+    samples = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            name, kind = line[len("# TYPE "):].rsplit(" ", 1)
+            assert kind in ("counter", "gauge", "histogram"), line
+            kinds[name] = kind
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? ([^ ]+)$", line)
+        assert m, f"unparseable exposition line: {line!r}"
+        samples.setdefault(m.group(1), []).append((m.group(2), float(m.group(3))))
+
+    # acceptance families, with their declared types
+    assert kinds["tpuml_subtasks_dispatched_total"] == "counter"
+    assert kinds["tpuml_subtasks_completed_total"] == "counter"
+    assert kinds["tpuml_subtasks_failed_total"] == "counter"
+    assert kinds["tpuml_subtasks_requeued_total"] == "counter"
+    assert kinds["tpuml_scheduler_placement_seconds"] == "histogram"
+    for phase in ("compile", "stage", "dispatch", "fetch"):
+        assert kinds[f"tpuml_executor_{phase}_seconds"] == "histogram"
+        # every histogram has cumulative buckets ending at +Inf == count
+        buckets = dict(samples[f"tpuml_executor_{phase}_seconds_bucket"])
+        count = samples[f"tpuml_executor_{phase}_seconds_count"][0][1]
+        assert buckets['{le="+Inf"}'] == count
+        values = [v for _, v in samples[f"tpuml_executor_{phase}_seconds_bucket"]]
+        assert values == sorted(values), f"{phase} buckets not cumulative"
+    assert kinds["tpuml_executable_cache_hits_total"] == "counter"
+    assert kinds["tpuml_executable_cache_misses_total"] == "counter"
+
+    # the direct-mode job actually moved the executor counters
+    assert samples["tpuml_subtasks_completed_total"][0][1] >= 1
+    assert samples["tpuml_executor_dispatch_seconds_count"][0][1] >= 1
+    assert (
+        samples["tpuml_executable_cache_hits_total"][0][1]
+        + samples["tpuml_executable_cache_misses_total"][0][1]
+        >= 1
+    )
+
+
+def test_trace_response_echoes_header(client):
+    resp = client.get("/health", headers={"X-Trace-Id": "abc123"})
+    assert resp.headers["X-Trace-Id"] == "abc123"
+
+
+def test_client_stream_consumes_sse_remote():
+    """train(..., stream=True) against a real socket consumes the
+    /train_status SSE stream (one request submits AND follows) instead of
+    polling /check_status."""
+    import threading
+
+    from werkzeug.serving import make_server
+
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+    from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+
+    coord = Coordinator()
+    server = make_server("127.0.0.1", 0, create_app(coord), threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        m = MLTaskManager(url=f"http://127.0.0.1:{server.server_port}")
+        before_status = REGISTRY.counter("tpuml_http_requests_total").value(
+            endpoint="check_status"
+        )
+        before_stream = REGISTRY.counter("tpuml_http_requests_total").value(
+            endpoint="train_status"
+        )
+        status = m.train(
+            LogisticRegression(max_iter=300), "iris",
+            stream=True, show_progress=False, timeout=120,
+        )
+        assert status["job_status"] == "completed"
+        assert status["job_result"]["best_result"]["accuracy"] > 0.8
+        assert m.result is not None
+        # the stream endpoint served it; no status polls were issued
+        assert REGISTRY.counter("tpuml_http_requests_total").value(
+            endpoint="train_status"
+        ) == before_stream + 1
+        assert REGISTRY.counter("tpuml_http_requests_total").value(
+            endpoint="check_status"
+        ) == before_status
+    finally:
+        server.shutdown()
+
+
+def test_client_stream_local_mode():
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+    m = MLTaskManager()
+    status = m.train(
+        LogisticRegression(max_iter=300), "iris",
+        stream=True, show_progress=False, timeout=120,
+    )
+    assert status["job_status"] == "completed"
+    assert status["job_result"] is not None
+
+
 def test_dashboard_and_jobs_feed(client):
     """The kafka-ui analog (reference docker-compose.yml:69-84): a
     self-contained HTML page plus the /jobs JSON feed it polls."""
