@@ -47,8 +47,10 @@ from .tracing import (  # noqa: F401 — re-exported API
     Tracer,
     activate,
     active_tracer,
+    current_span_id,
     current_trace_id,
     new_trace_id,
+    process_token,
     record_phase,
     span,
     use_tracer,
@@ -169,6 +171,48 @@ def register_catalog() -> None:
         "tpuml_executor_fetch_seconds",
         "Blocking device->host result fetches",
     )
+    # ---- device cost accounting (docs/OBSERVABILITY.md "Cost accounting") ----
+    c(
+        "tpuml_executor_flops_total",
+        "Model FLOPs executed per batch (analytical estimate; XLA "
+        "cost-analysis fallback), labeled by model",
+    )
+    c(
+        "tpuml_executor_bytes_total",
+        "Bytes accessed per batch per XLA cost analysis, labeled by model",
+    )
+    g(
+        "tpuml_executor_mfu",
+        "Model-FLOP utilization of the most recent batch (fraction of "
+        "device peak), labeled by model; absent on CPU backends",
+    )
+    g(
+        "tpuml_device_hbm_bytes",
+        "Local device memory, labeled kind=used|peak|limit (absent when "
+        "the backend exposes no memory_stats)",
+    )
+    # ---- per-worker health (docs/OBSERVABILITY.md "Worker health") ----
+    g(
+        "tpuml_worker_ewma_batch_seconds",
+        "EWMA of a worker's batch wall time, labeled by wid",
+    )
+    g(
+        "tpuml_worker_heartbeat_age_seconds",
+        "Seconds since a worker's last heartbeat, labeled by wid "
+        "(refreshed at scrape)",
+    )
+    g(
+        "tpuml_worker_failure_ratio",
+        "Failed / total subtask outcomes per worker, labeled by wid",
+    )
+    g(
+        "tpuml_worker_queue_depth",
+        "Queued subtasks per worker, labeled by wid",
+    )
+    g(
+        "tpuml_worker_straggler",
+        "1 while a worker is flagged as a straggler, labeled by wid",
+    )
 
 
 register_catalog()
@@ -196,5 +240,7 @@ __all__ = [
     "use_tracer",
     "active_tracer",
     "current_trace_id",
+    "current_span_id",
     "new_trace_id",
+    "process_token",
 ]

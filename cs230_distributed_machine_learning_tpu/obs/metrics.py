@@ -51,12 +51,19 @@ def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline) — a label value fed from a wire message (e.g. a remote
+    agent's ``algo``) must not be able to break the whole scrape."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(key: LabelKey, extra: Optional[Tuple[str, str]] = None) -> str:
     items = list(key) + ([extra] if extra else [])
     if not items:
         return ""
     body = ",".join(
-        f'{k}="{v}"' for k, v in items
+        f'{k}="{_escape_label_value(v)}"' for k, v in items
     )
     return "{" + body + "}"
 
@@ -129,6 +136,17 @@ class Gauge:
     def value(self, **labels: str) -> float:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
+
+    def remove(self, **labels: str) -> None:
+        """Drop one labeled cell — a gauge keyed by worker id must not keep
+        exposing a dead/unsubscribed worker forever."""
+        with self._lock:
+            self._values.pop(_label_key(labels), None)
+
+    def labelsets(self) -> List[Dict[str, str]]:
+        """Current label sets with a live cell (introspection/tests)."""
+        with self._lock:
+            return [dict(key) for key in self._values]
 
     def render(self) -> List[str]:
         out = [

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.base import ModelKernel, TrialData
-from ..obs import counter_inc, observe
+from ..obs import counter_inc, obs_enabled, observe
 from ..ops.folds import SplitPlan
 from ..utils.aot_cache import aot_jit
 from .distributed import fetch as _fetch
@@ -66,6 +66,62 @@ _PHASE = _PhaseAcc()
 
 def _sds(a):
     return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+# ---- device cost accounting -----------------------------------------------
+#
+# Promotes the offline bench helpers (utils/flops.py) to runtime telemetry:
+# each cached executable carries its XLA cost analysis (flops, bytes
+# accessed), captured ONCE at construction, and every dispatch accumulates
+# it into the TrialRunResult so the executor can derive achieved-FLOP/s and
+# MFU per batch. The analytical model-FLOP estimate (kernel.macs_estimate)
+# is accumulated per bucket alongside — it is the MFU numerator (model
+# FLOPs, comparable across implementations; see utils/flops docstring)
+# while the XLA figure prices what the hardware actually did.
+
+
+def _cost_capture_enabled() -> bool:
+    """Capturing an executable's cost analysis costs one extra trace+lower
+    at construction time (never on the dispatch hot path). Rides the master
+    CS230_OBS valve; CS230_COST_ANALYSIS=0 turns just the XLA capture off
+    (the free analytical accounting stays)."""
+    return (
+        obs_enabled()
+        and os.environ.get("CS230_COST_ANALYSIS", "1") != "0"
+    )
+
+
+def _capture_cost(fn, example_args) -> Optional[Dict[str, float]]:
+    """XLA cost analysis of ``fn`` lowered at ``example_args``:
+    {"flops": ..., "bytes": ...} (either value may be absent), or None when
+    capture is disabled or the backend/lowering offers no analysis. Runs at
+    executable-construction time only — results are cached in
+    ``_compiled_cache`` beside the executable."""
+    if not _cost_capture_enabled():
+        return None
+    try:
+        analysis = jax.jit(fn).lower(*example_args).cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # per-device form
+            analysis = analysis[0] if analysis else {}
+        out: Dict[str, float] = {}
+        flops = analysis.get("flops")
+        if flops is not None and float(flops) > 0:
+            out["flops"] = float(flops)
+        nbytes = analysis.get("bytes accessed")
+        if nbytes is not None and float(nbytes) > 0:
+            out["bytes"] = float(nbytes)
+        return out or None
+    except Exception:  # noqa: BLE001 — accounting must never fail a job
+        return None
+
+
+def _hbm_peak_bytes() -> Optional[int]:
+    """Device-0 HBM high-water (peak_bytes_in_use); None on backends with
+    no memory_stats (CPU)."""
+    from ..utils.flops import device_memory_stats
+
+    peak = device_memory_stats().get("peak_bytes_in_use")
+    return int(peak) if peak is not None else None
 
 
 # ---- packed single-fetch result transport ---------------------------------
@@ -424,6 +480,25 @@ class TrialRunResult:
     stage_time_s: float = 0.0
     #: wall seconds in blocking device->host result fetches
     fetch_time_s: float = 0.0
+    # ---- device cost accounting (None when CS230_OBS=0 / unavailable) ----
+    #: analytical model FLOPs of the whole run (2 * macs * splits * trials,
+    #: summed over buckets whose kernel publishes macs_estimate) — the MFU
+    #: numerator
+    model_flops: Optional[float] = None
+    #: XLA cost-analysis FLOPs summed over dispatches (what the hardware
+    #: actually executed, padding and recompute included)
+    xla_flops: Optional[float] = None
+    #: XLA cost-analysis bytes accessed, summed over dispatches
+    bytes_accessed: Optional[float] = None
+    #: fraction of this run's buckets with a model-FLOP estimate (1.0 =
+    #: model_flops prices the whole run; consumers must not read a partial
+    #: sum as a total)
+    flops_coverage: Optional[float] = None
+    #: device-0 HBM high-water at run end (peak_bytes_in_use — MONOTONIC
+    #: over the process lifetime, not per-run; the executor's in-fit
+    #: sampler supplies the per-batch figure and uses this as fallback);
+    #: None on CPU
+    hbm_peak_bytes: Optional[int] = None
 
 
 def run_trials(
@@ -458,6 +533,21 @@ def run_trials(
     dispatches = 0
     n_fetches = 0
     result_bytes = 0
+    # cost accounting for THIS run (valve read once: a mid-run flip must
+    # not produce a half-priced result)
+    acct = obs_enabled()
+    model_flops = 0.0
+    n_buckets = 0
+    buckets_priced = 0
+    xla_flops = 0.0
+    xla_bytes = 0.0
+
+    def _acc_cost(cost: Optional[Dict[str, float]]) -> None:
+        # one dispatch of an executable executes its cost analysis once
+        nonlocal xla_flops, xla_bytes
+        if cost:
+            xla_flops += cost.get("flops", 0.0)
+            xla_bytes += cost.get("bytes", 0.0)
     # phase accumulators for THIS call (thread-local: concurrent jobs in
     # other threads keep their own) — read back into the TrialRunResult
     _PHASE.stage = 0.0
@@ -580,6 +670,22 @@ def run_trials(
         if hasattr(kernel, "bucket_static"):
             static = kernel.bucket_static(static, [hypers[i] for i in idxs])
 
+        # analytical model FLOPs of the whole bucket (2 * per-(trial,split)
+        # MACs * splits * trials) — free to compute, covers every dispatch
+        # path (generic/host/batched/chunked) the bucket takes below
+        n_buckets += 1
+        if acct and hasattr(kernel, "macs_estimate"):
+            try:
+                macs = _call_with_prepared(
+                    kernel.macs_estimate, X_np, n, d, static
+                )
+                model_flops += (
+                    2.0 * float(macs) * max(plan.n_splits, 1) * len(idxs)
+                )
+                buckets_priced += 1
+            except Exception:  # noqa: BLE001 — estimator bug: unpriced bucket
+                pass
+
         hyper_names = sorted(hypers[idxs[0]].keys())
         single_device = mesh is None or int(np.prod(list(mesh.shape.values()))) == 1
 
@@ -677,6 +783,7 @@ def run_trials(
             continue
 
         out_spec: Optional[_PackSpec] = None
+        exec_cost: Optional[Dict[str, float]] = None
         if host_exec:
             X_d = X
             y_d = put(y_np)
@@ -689,15 +796,18 @@ def run_trials(
             _cache_count(not fresh_compile)
             if fresh_compile:
                 raw = _make_batched(kernel, static, bool(hyper_names))
+                example = _example_args(
+                    X, y_np, plan.train_w, plan.eval_w, hyper_names, chunk
+                )
+                # cost captured on the pre-pack form: the executable's
+                # priced work must not vary with the transport knob
+                cost = _capture_cost(raw, example)
                 spec = None
                 if _packed_enabled():
-                    example = _example_args(
-                        X, y_np, plan.train_w, plan.eval_w, hyper_names, chunk
-                    )
                     spec = _pack_spec_of(raw, example)
                     raw = _pack_wrap(raw)
-                _compiled_cache[cache_key] = (jax.jit(raw), spec)
-            fn, out_spec = _compiled_cache[cache_key]
+                _compiled_cache[cache_key] = (jax.jit(raw), spec, cost)
+            fn, out_spec, exec_cost = _compiled_cache[cache_key]
 
         # Kernels with a fused batched path (e.g. the Pallas packed
         # LogisticRegression fit, models/logistic.py) take over the whole
@@ -741,13 +851,14 @@ def run_trials(
                     raw = _decode_wrap(batched_fn)
                 example = _example_args(X, y_np, plan.train_w, plan.eval_w,
                                         hyper_names, chunk)
+                cost = _capture_cost(raw, example)
                 spec = None
                 if _packed_enabled():
                     spec = _pack_spec_of(raw, example)
                     raw = _pack_wrap(raw)
                 compiled, _ = aot_jit(raw, cache_key, example)
-                _compiled_cache[cache_key] = (compiled, spec)
-            fn, out_spec = _compiled_cache[cache_key]
+                _compiled_cache[cache_key] = (compiled, spec, cost)
+            fn, out_spec, exec_cost = _compiled_cache[cache_key]
         elif not host_exec:
             y_d, TW_d, EW_d = _dev_args()
             X_d = X
@@ -786,7 +897,7 @@ def run_trials(
                             (jnp.asarray(twg), jnp.asarray(ewg), size))
             if split_groups is not None:
                 TW_g = split_groups[0][0]
-                fn, out_spec, fresh_compile = _get_compiled(
+                fn, out_spec, exec_cost, fresh_compile = _get_compiled(
                     kernel, static_key, static, mesh, trial_axis, data, plan,
                     chunk, hyper_names, X, y_np,
                     np.asarray(TW_g), np.asarray(split_groups[0][1]),
@@ -794,7 +905,7 @@ def run_trials(
                     stage_mode=stage_mode,
                 )
             else:
-                fn, out_spec, fresh_compile = _get_compiled(
+                fn, out_spec, exec_cost, fresh_compile = _get_compiled(
                     kernel, static_key, static, mesh, trial_axis, data, plan,
                     chunk, hyper_names, X, y_np, plan.train_w, plan.eval_w,
                     stage_mode=stage_mode,
@@ -824,6 +935,7 @@ def run_trials(
                 for gi_, (twg, ewg, size) in enumerate(split_groups):
                     out_g = fn(X_d, y_d, twg, ewg, hyper_arg)
                     dispatches += 1
+                    _acc_cost(exec_cost)
                     if fresh_compile and start == 0 and gi_ == 0:
                         # attribute the XLA compile to the FIRST group only;
                         # later groups reuse the executable and their device
@@ -857,6 +969,7 @@ def run_trials(
                 pending_best.append((bi, bs, batch_idx))
             pending.append((out, batch_idx))
             dispatches += 1
+            _acc_cost(exec_cost)
 
     _drain()
 
@@ -870,6 +983,13 @@ def run_trials(
         result_bytes=result_bytes,
         stage_time_s=_PHASE.stage,
         fetch_time_s=_PHASE.fetch,
+        model_flops=model_flops if acct and buckets_priced else None,
+        xla_flops=xla_flops if acct and xla_flops > 0 else None,
+        bytes_accessed=xla_bytes if acct and xla_bytes > 0 else None,
+        flops_coverage=(
+            buckets_priced / n_buckets if acct and n_buckets else None
+        ),
+        hbm_peak_bytes=_hbm_peak_bytes() if acct else None,
     )
 
 
@@ -1076,10 +1196,14 @@ def _mesh_signature(mesh):
 def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chunk,
                   hyper_names, X_proto=None, y=None, TW=None, EW=None,
                   n_splits_override=None, stage_mode="f32"):
-    """Returns (fn, pack_spec_or_None, fresh). Single-device executables
-    take the packed-output form (one uint8 result buffer, see _pack_wrap);
-    mesh executables keep the per-leaf dict — their score vector feeds the
-    on-device collective argmax and the cross-process collective fetch."""
+    """Returns (fn, pack_spec_or_None, cost_or_None, fresh). Single-device
+    executables take the packed-output form (one uint8 result buffer, see
+    _pack_wrap) and carry their XLA cost analysis (captured once, at
+    construction); mesh executables keep the per-leaf dict — their score
+    vector feeds the on-device collective argmax and the cross-process
+    collective fetch — and skip cost capture (sharded lowering would pay a
+    second full trace; the analytical bucket accounting still prices
+    them)."""
     has_hyper = bool(hyper_names)
     n_splits_key = n_splits_override or plan.n_splits
     # a 1-device mesh is compilation-equivalent to no mesh: drop the
@@ -1109,8 +1233,8 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
     )
     if cache_key in _compiled_cache:
         _cache_count(True)
-        fn, spec = _compiled_cache[cache_key]
-        return fn, spec, False
+        fn, spec, cost = _compiled_cache[cache_key]
+        return fn, spec, cost, False
     _cache_count(False)
 
     batched = _make_batched(kernel, static, has_hyper)
@@ -1155,6 +1279,7 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
                 out_shardings=trial_sharded,
             )
         spec = None
+        cost = None
     else:
         X_ex = X_proto if X_proto is not None else jax.ShapeDtypeStruct(
             data.X.shape, jnp.float32
@@ -1164,13 +1289,14 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
             kernel, static, X_ex, data.n_classes, n_splits_key, chunk,
             hyper_names, stage_mode=stage_mode,
         )
+        cost = _capture_cost(batched, example)
         spec = None
         if _packed_enabled():
             spec = _pack_spec_of(batched, example)
             batched = _pack_wrap(batched)
         fn, _ = aot_jit(batched, disk_key, example)
-    _compiled_cache[cache_key] = (fn, spec)
-    return fn, spec, True
+    _compiled_cache[cache_key] = (fn, spec, cost)
+    return fn, spec, cost, True
 
 
 def _run_chunked(

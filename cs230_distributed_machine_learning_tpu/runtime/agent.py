@@ -224,10 +224,16 @@ class WorkerAgent:
     def _post_result(self, stid: str, status: str, result: Optional[Dict[str, Any]]) -> None:
         import requests
 
+        from ..obs import process_token
+
         try:
+            # obs_pid rides the wire only (popped at ingest): the
+            # coordinator's push_result counts subtask outcomes for REMOTE
+            # processes and must skip an agent sharing its own process,
+            # whose executor already counted into the shared registry
             requests.post(
                 f"{self.url}/task_result/{self.worker_id}",
-                json=json_safe(result),
+                json={**json_safe(result), "obs_pid": process_token()},
                 timeout=30,
             )
         except Exception:  # noqa: BLE001

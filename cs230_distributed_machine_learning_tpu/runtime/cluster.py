@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..obs import counter_inc, observe
+from ..obs import counter_inc, gauge_set, observe, process_token
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .executor import DeviceLostError, LocalExecutor
@@ -91,12 +91,18 @@ class ExecutorWorker:
                 # crash between dequeue and execution: tasks are lost here and
                 # recovered by the dead-worker requeue (at-least-once)
                 return
+            def on_result(stid, status, result):
+                # in-process workers bypass push_result, so the engine's
+                # per-worker failure accounting hooks here
+                self.cluster.engine.record_outcome(
+                    self.worker_id, status != "failed"
+                )
+                self.cluster.bus.publish(TOPIC_RESULT, result, key=stid)
+
             try:
                 self.executor.run_subtasks(
                     batch,
-                    on_result=lambda stid, status, result: self.cluster.bus.publish(
-                        TOPIC_RESULT, result, key=stid
-                    ),
+                    on_result=on_result,
                     on_metrics=lambda msg: self.cluster.bus.publish(
                         TOPIC_METRICS, {**msg, "worker_id": self.worker_id}, key=msg.get("subtask_id")
                     ),
@@ -200,25 +206,34 @@ class ClusterRuntime:
 
     def push_result(self, worker_id: str, result: Dict[str, Any]) -> None:
         counter_inc("tpuml_agent_acks_total")
-        # REMOTE agents only reach this path (in-process workers publish to
-        # the bus directly and their executor already counted locally):
+        result = dict(result or {})
+        # wire-only dedup stamp (agent._post_result): popped so it never
+        # reaches the job store / client-visible results
+        src_pid = result.pop("obs_pid", None)
+        ok = result.get("status") != "failed"
+        self.engine.record_outcome(worker_id, ok)
         # count the outcome coordinator-side so /metrics/prom sees subtasks
-        # executed in other processes too
-        counter_inc(
-            "tpuml_subtasks_failed_total"
-            if (result or {}).get("status") == "failed"
-            else "tpuml_subtasks_completed_total"
-        )
+        # executed in other processes — but not twice for an agent sharing
+        # THIS process (its executor already counted into the shared
+        # registry; same contract as push_metrics' obs_pid skip)
+        if src_pid != process_token():
+            counter_inc(
+                "tpuml_subtasks_completed_total"
+                if ok
+                else "tpuml_subtasks_failed_total"
+            )
         self.bus.publish(TOPIC_RESULT, result, key=result.get("subtask_id"))
 
     def push_metrics(self, worker_id: str, msg: Dict[str, Any]) -> None:
-        # remote executor phase timers -> the coordinator's histograms.
-        # Agents' registries live in their own processes with no exposition
-        # endpoint, so the batch totals ride the metrics message instead;
-        # batch_primary marks exactly one message per batch (dedup). An
-        # in-test agent sharing this process double-observes into the same
-        # registry — cosmetic there, absent in real multi-process fleets.
-        if msg.get("batch_primary"):
+        # remote executor phase timers + cost figures -> the coordinator's
+        # registry. Agents' registries live in their own processes with no
+        # exposition endpoint, so the batch totals ride the metrics message
+        # instead; batch_primary marks exactly one message per batch, and
+        # obs_pid marks which process already observed it locally — an
+        # agent sharing THIS process (the test topology) is skipped here,
+        # so nothing double-observes into the shared registry
+        # (docs/OBSERVABILITY.md; pinned by tests/test_cost_health.py).
+        if msg.get("batch_primary") and msg.get("obs_pid") != process_token():
             for field, metric in (
                 ("batch_compile_s", "tpuml_executor_compile_seconds"),
                 ("batch_stage_s", "tpuml_executor_stage_seconds"),
@@ -228,6 +243,22 @@ class ClusterRuntime:
                 v = msg.get(field)
                 if isinstance(v, (int, float)):
                     observe(metric, float(v))
+            algo = str(msg.get("algo") or "unknown")
+            flops = msg.get("batch_model_flops")
+            if flops is None:
+                flops = msg.get("batch_xla_flops")
+            if isinstance(flops, (int, float)):
+                counter_inc(
+                    "tpuml_executor_flops_total", float(flops), model=algo
+                )
+            nbytes = msg.get("batch_bytes_accessed")
+            if isinstance(nbytes, (int, float)):
+                counter_inc(
+                    "tpuml_executor_bytes_total", float(nbytes), model=algo
+                )
+            mfu_v = msg.get("batch_mfu")
+            if isinstance(mfu_v, (int, float)):
+                gauge_set("tpuml_executor_mfu", float(mfu_v), model=algo)
         self.bus.publish(
             TOPIC_METRICS, {**msg, "worker_id": worker_id}, key=msg.get("subtask_id")
         )

@@ -435,6 +435,85 @@ class Coordinator:
             logger.exception("metrics.json snapshot failed")
         return results
 
+    def job_cost(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Hardware-grounded cost report for a job: device-seconds, total
+        model/XLA FLOPs and bytes, HBM high-water, and per-group MFU —
+        aggregated from the ``batch_cost`` records the executors stamp onto
+        each batch's primary result (runtime/executor._record_batch_cost).
+        None when the job id is unknown; a known job with no cost records
+        (CS230_OBS=0, or a run predating the accounting layer) reports
+        zeros with an empty ``groups`` list. Schema:
+        docs/OBSERVABILITY.md "Job cost report"."""
+        sid = next(
+            (
+                j["session_id"]
+                for j in self.store.jobs_overview()
+                if j["job_id"] == job_id
+            ),
+            None,
+        )
+        if sid is None:
+            return None
+        from ..utils.flops import device_peak_flops
+
+        progress = self.store.job_progress(sid, job_id)
+        groups: List[Dict[str, Any]] = []
+        device_seconds = 0.0
+        capacity_device_seconds = 0.0  # device_seconds x participating devices
+        model_flops = 0.0
+        xla_flops = 0.0
+        bytes_accessed = 0.0
+        hbm_peak = None
+        priced = True  # every group carries a model-FLOP figure
+        for r in self.store.subtask_results(sid, job_id):
+            cost = (r or {}).get("batch_cost")
+            if not cost:
+                continue
+            groups.append(dict(cost))
+            device_seconds += float(cost.get("device_seconds") or 0.0)
+            capacity_device_seconds += float(
+                cost.get("device_seconds") or 0.0
+            ) * max(int(cost.get("n_devices") or 1), 1)
+            # a group counts as priced only with a COMPLETE model-FLOP sum
+            # (flops_coverage 1.0) — job MFU from partial sums would
+            # understate utilization and read as a real figure
+            if (
+                cost.get("model_flops") is not None
+                and cost.get("flops_coverage") == 1.0
+            ):
+                model_flops += float(cost["model_flops"])
+            else:
+                priced = False
+            if cost.get("xla_flops") is not None:
+                xla_flops += float(cost["xla_flops"])
+            if cost.get("bytes_accessed") is not None:
+                bytes_accessed += float(cost["bytes_accessed"])
+            if cost.get("hbm_peak_bytes") is not None:
+                hbm_peak = max(hbm_peak or 0, int(cost["hbm_peak_bytes"]))
+        peak = device_peak_flops()
+        mfu = None
+        if peak and capacity_device_seconds > 0 and model_flops > 0 and priced:
+            # capacity-weighted: each group's window counts once per
+            # participating device, so mesh batches don't inflate MFU
+            mfu = model_flops / (capacity_device_seconds * peak)
+        return {
+            "job_id": job_id,
+            "session_id": sid,
+            "job_status": progress.get("job_status"),
+            "n_groups": len(groups),
+            "device_seconds": device_seconds,
+            "model_flops": model_flops if groups and priced else None,
+            "xla_flops": xla_flops if xla_flops > 0 else None,
+            "bytes_accessed": bytes_accessed if bytes_accessed > 0 else None,
+            "hbm_peak_bytes": hbm_peak,
+            # MFU is null off-accelerator (device_peak_flops() is None on
+            # CPU — utilization of a host backend is not a meaningful
+            # number) and whenever any group lacks a model-FLOP estimate
+            "mfu": mfu,
+            "device_peak_flops": peak,
+            "groups": groups,
+        }
+
     def wait_for_completion(self, sid: str, job_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
         timeout = timeout_s or self.config.service.client_timeout_s
         if not self.store.wait_job(sid, job_id, timeout):

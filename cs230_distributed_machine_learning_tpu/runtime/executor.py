@@ -14,22 +14,52 @@ engine's runtime predictor) are drop-in.
 from __future__ import annotations
 
 import contextlib
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
 from ..data.datasets import DatasetCache
 from ..models.registry import get_kernel
-from ..obs import counter_inc, observe, record_phase, span
+from ..obs import (
+    counter_inc,
+    gauge_set,
+    obs_enabled,
+    observe,
+    process_token,
+    record_phase,
+    span,
+)
 from ..ops.folds import build_split_plan
 from ..parallel.trial_map import fit_single, run_trials
 from ..utils.config import get_config
+from ..utils.flops import mfu as _mfu
 from ..utils.logging import get_logger
 
 logger = get_logger("tpuml.executor")
 
 ResultCallback = Callable[[str, str, Optional[Dict[str, Any]]], None]
 MetricsCallback = Callable[[Dict[str, Any]], None]
+
+
+def record_hbm_gauges() -> None:
+    """Refresh ``tpuml_device_hbm_bytes{kind=used|peak|limit}`` from the
+    local device's memory_stats. Backends without stats (CPU) write
+    nothing — the family stays at its registered zero. Called after every
+    executed batch and at /metrics/prom scrape time."""
+    if not obs_enabled():
+        return
+    from ..utils.flops import device_memory_stats
+
+    stats = device_memory_stats()
+    for kind, key in (
+        ("used", "bytes_in_use"),
+        ("peak", "peak_bytes_in_use"),
+        ("limit", "bytes_limit"),
+    ):
+        v = stats.get(key)
+        if v is not None:
+            gauge_set("tpuml_device_hbm_bytes", float(v), kind=kind)
 
 
 class ResourceSampler:
@@ -55,17 +85,13 @@ class ResourceSampler:
         # max over CURRENT bytes_in_use samples: this fit's observed peak.
         # (peak_bytes_in_use is monotonic over the backend's lifetime — it
         # would report the largest batch ever, not this one)
-        try:
-            import jax
+        from ..utils.flops import device_memory_stats
 
-            stats = jax.local_devices()[0].memory_stats() or {}
-            used = stats.get("bytes_in_use")
-            if used is not None:
-                mb = used / 1e6
-                if self._dev_peak_mb is None or mb > self._dev_peak_mb:
-                    self._dev_peak_mb = mb
-        except Exception:  # noqa: BLE001 — stats are best-effort (cpu backend)
-            pass
+        used = device_memory_stats().get("bytes_in_use")
+        if used is not None:
+            mb = used / 1e6
+            if self._dev_peak_mb is None or mb > self._dev_peak_mb:
+                self._dev_peak_mb = mb
 
     def _loop(self) -> None:
         try:
@@ -270,8 +296,11 @@ class LocalExecutor:
                 )
         finished_at = time.time()
         observe("tpuml_executor_dispatch_seconds", run.run_time_s)
-        self._record_batch_phases(batch_sp, run, started_at)
         resources = sampler.averages()
+        batch_cost = self._record_batch_cost(
+            run, model_type, dataset_id, len(idxs), resources
+        )
+        self._record_batch_phases(batch_sp, run, started_at, batch_cost)
         per_trial_time = run.run_time_s / max(len(idxs), 1)
         # winner-by-ICI-collective: run_trials' on-device argmax over
         # the mesh-sharded scores (multi-device only). The marked
@@ -294,6 +323,11 @@ class LocalExecutor:
             }
             if device_best_pos == j:
                 result["device_argmax"] = True
+            if j == 0 and batch_cost is not None:
+                # the batch's cost record rides exactly ONE result (the
+                # primary) into the job store, where GET /cost/<job_id>
+                # aggregates it — stamping every result would overcount
+                result["batch_cost"] = batch_cost
             results[gi] = result
             counter_inc("tpuml_subtasks_completed_total")
             if on_result:
@@ -304,11 +338,77 @@ class LocalExecutor:
                         st, received_at, started_at, finished_at,
                         model_type, resources, run=run,
                         batch_size=len(idxs), primary=(j == 0),
+                        batch_cost=batch_cost,
                     )
                 )
 
+    def _record_batch_cost(
+        self, run, model_type: str, dataset_id: str, batch_size: int,
+        resources: Optional[Dict[str, Any]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """Device cost accounting for one executed batch: feed the
+        ``tpuml_executor_flops_total`` / ``_bytes_total`` / ``_mfu`` /
+        ``tpuml_device_hbm_bytes`` families and build the per-batch cost
+        record that rides the primary result into the job store (the
+        ``GET /cost/<job_id>`` input). Returns None when CS230_OBS=0 —
+        the valve disables cost accounting end to end."""
+        if not obs_enabled():
+            return None
+        n_devices = 1
+        if self.mesh is not None:
+            import numpy as np
+
+            n_devices = int(np.prod(list(self.mesh.shape.values())))
+        flops = run.model_flops if run.model_flops is not None else run.xla_flops
+        # MFU only from a COMPLETE model-FLOP sum (a partially priced run
+        # must report null, not an understated figure — flops_coverage
+        # contract, trial_map), over the peak of EVERY participating
+        # device (whole-mesh FLOPs over one chip's peak would read Nx)
+        mfu_val = (
+            _mfu(run.model_flops, run.run_time_s, n_devices=n_devices)
+            if run.flops_coverage == 1.0
+            else None
+        )
+        if flops is not None:
+            counter_inc("tpuml_executor_flops_total", flops, model=model_type)
+        if run.bytes_accessed is not None:
+            counter_inc(
+                "tpuml_executor_bytes_total", run.bytes_accessed,
+                model=model_type,
+            )
+        if mfu_val is not None:
+            gauge_set("tpuml_executor_mfu", mfu_val, model=model_type)
+        record_hbm_gauges()
+        # per-batch HBM: the sampler's max over bytes_in_use DURING this
+        # fit (memory_stats' peak_bytes_in_use is monotonic over the
+        # process lifetime — it would pin every later batch to the
+        # largest batch ever; run.hbm_peak_bytes keeps that lifetime
+        # high-water as the fallback when the sampler saw nothing)
+        dev_peak_mb = (resources or {}).get("device_peak_mem_mb")
+        hbm_peak = (
+            int(dev_peak_mb * 1e6)
+            if dev_peak_mb is not None
+            else run.hbm_peak_bytes
+        )
+        return {
+            "model_type": model_type,
+            "dataset_id": dataset_id,
+            "n_subtasks": batch_size,
+            "n_devices": n_devices,
+            "device_seconds": run.run_time_s,
+            "model_flops": run.model_flops,
+            "xla_flops": run.xla_flops,
+            "bytes_accessed": run.bytes_accessed,
+            "flops_coverage": run.flops_coverage,
+            "mfu": mfu_val,
+            "hbm_peak_bytes": hbm_peak,
+        }
+
     @staticmethod
-    def _record_batch_phases(batch_sp, run, started_at: float) -> None:
+    def _record_batch_phases(
+        batch_sp, run, started_at: float,
+        batch_cost: Optional[Dict[str, Any]] = None,
+    ) -> None:
         """Attach the trial engine's measured phase totals to the batch
         span as synthesized children. Phases are laid out sequentially from
         batch start (real execution overlaps stage/dispatch/fetch — the
@@ -323,6 +423,16 @@ class LocalExecutor:
             compile_time_s=round(run.compile_time_s, 6),
             run_time_s=round(run.run_time_s, 6),
         )
+        if batch_cost is not None:
+            # cost attrs join the span so trace timelines price themselves
+            batch_sp.attrs.update(
+                {
+                    k: batch_cost[k]
+                    for k in ("model_flops", "xla_flops", "bytes_accessed",
+                              "mfu", "hbm_peak_bytes")
+                    if batch_cost.get(k) is not None
+                }
+            )
         t = record_phase(
             batch_sp, "executor.compile", run.compile_time_s, start=started_at
         )
@@ -357,7 +467,7 @@ class LocalExecutor:
 
     def _metrics_message(self, st, received_at, started_at, finished_at,
                          algo, resources=None, run=None, batch_size=1,
-                         primary=False):
+                         primary=False, batch_cost=None):
         """Reference metrics schema (worker.py:233-243): CPU/mem averaged
         over the fit by the 0.5 s-cadence ResourceSampler (the predictor's
         feature inputs), plus device peak-memory — the accelerator signal
@@ -376,6 +486,13 @@ class LocalExecutor:
             "mem_percent_avg": resources.get("mem_percent_avg"),
             "device_peak_mem_mb": resources.get("device_peak_mem_mb"),
             "algo": algo,
+            # the process (host:pid) that ALREADY observed this batch's
+            # phase/cost metrics into its local registry — the
+            # coordinator's ingest (cluster.push_metrics) skips
+            # re-observing when the message originated in its own process
+            # (the in-process-agent test topology would otherwise
+            # double-observe; docs/OBSERVABILITY.md)
+            "obs_pid": process_token(),
         }
         if run is not None:
             # batch_-prefixed: these are totals for the WHOLE run_trials
@@ -395,6 +512,16 @@ class LocalExecutor:
             msg["batch_stage_s"] = run.stage_time_s
             msg["batch_dispatch_s"] = run.run_time_s
             msg["batch_fetch_s"] = run.fetch_time_s
+        if batch_cost is not None:
+            # remote agents have no exposition endpoint: the batch's cost
+            # figures ride the metrics message so the coordinator's ingest
+            # can count them fleet-wide (same dedup contract as the phase
+            # timers: batch_primary + obs_pid)
+            msg["batch_model_flops"] = batch_cost.get("model_flops")
+            msg["batch_xla_flops"] = batch_cost.get("xla_flops")
+            msg["batch_bytes_accessed"] = batch_cost.get("bytes_accessed")
+            msg["batch_mfu"] = batch_cost.get("mfu")
+            msg["batch_hbm_peak_bytes"] = batch_cost.get("hbm_peak_bytes")
         return msg
 
 

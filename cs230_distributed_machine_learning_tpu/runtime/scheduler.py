@@ -54,9 +54,26 @@ class WorkerState:
     task_est: Dict[str, float] = dataclasses.field(default_factory=dict)
     task_mem: Dict[str, float] = dataclasses.field(default_factory=dict)
     alive: bool = True
+    # ---- health telemetry (docs/OBSERVABILITY.md "Worker health") ----
+    #: EWMA of this worker's batch wall time (None until the first batch)
+    ewma_batch_s: Optional[float] = None
+    #: batches absorbed into the EWMA (the straggler-guard denominator:
+    #: outcomes arrive per SUBTASK, so counting them would let one cold
+    #: multi-subtask batch satisfy the min-batches guard)
+    n_batches: int = 0
+    #: subtask outcomes reported for this worker
+    n_completed: int = 0
+    n_failed: int = 0
 
     def effective_finish_time(self) -> float:
         return self.load_seconds / max(self.speed_factor, 1e-3)
+
+    def n_outcomes(self) -> int:
+        return self.n_completed + self.n_failed
+
+    def failure_ratio(self) -> float:
+        total = self.n_outcomes()
+        return self.n_failed / total if total else 0.0
 
 
 class PlacementEngine:
@@ -70,6 +87,8 @@ class PlacementEngine:
         self._next_id = 0
         self._stop = threading.Event()
         self._monitor_thread: Optional[threading.Thread] = None
+        #: workers currently flagged as stragglers (transition logging)
+        self._flagged: set = set()
 
     # ---------------- registry (subscribe/heartbeat/unsubscribe) ----------------
 
@@ -91,6 +110,7 @@ class PlacementEngine:
         with self._lock:
             state = self.workers.pop(worker_id, None)
             gauge_set("tpuml_workers_alive", len(self.workers))
+        self._drop_worker_gauges(worker_id)
         if state is None:
             return []
         logger.info("Worker %s unsubscribed; requeueing %d tasks", worker_id, len(state.tasks_queue))
@@ -125,6 +145,135 @@ class PlacementEngine:
                 for wid, w in self.workers.items()
             }
 
+    # ---------------- per-worker health ----------------
+
+    def record_outcome(self, worker_id: str, ok: bool) -> None:
+        """Count one subtask outcome against a worker — the failure-rate
+        input. Fed by the cluster's result paths (in-process worker
+        callbacks and remote /task_result ingest)."""
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is None:
+                return
+            if ok:
+                w.n_completed += 1
+            else:
+                w.n_failed += 1
+
+    def _straggler_ids_locked(self) -> set:
+        """Workers whose batch EWMA exceeds ``straggler_factor`` x the
+        median EWMA of their PEERS (own value excluded, so a two-worker
+        pool can still flag its slow half). Requires
+        ``straggler_min_batches`` reported outcomes — one slow cold batch
+        must not brand a fresh worker. Caller holds the lock."""
+        cfg = self.cfg
+        measured = [
+            (wid, w.ewma_batch_s)
+            for wid, w in self.workers.items()
+            if w.ewma_batch_s is not None
+            and w.n_batches >= cfg.straggler_min_batches
+        ]
+        if len(measured) < 2:
+            return set()
+        flagged = set()
+        for wid, ewma in measured:
+            others = sorted(v for o, v in measured if o != wid)
+            mid = len(others) // 2
+            median = (
+                others[mid]
+                if len(others) % 2
+                else 0.5 * (others[mid - 1] + others[mid])
+            )
+            if median > 0 and ewma > cfg.straggler_factor * median:
+                flagged.add(wid)
+        return flagged
+
+    def _health_snapshot_locked(self) -> Dict[str, Dict[str, Any]]:
+        now = time.time()
+        stragglers = self._straggler_ids_locked()
+        return {
+            wid: {
+                "ewma_batch_s": w.ewma_batch_s,
+                "heartbeat_age_s": round(now - w.last_heartbeat, 3),
+                "completed": w.n_completed,
+                "failed": w.n_failed,
+                "failure_ratio": w.failure_ratio(),
+                "queue_depth": len(w.tasks_queue),
+                "load_seconds": w.load_seconds,
+                "speed_factor": w.speed_factor,
+                "straggler": wid in stragglers,
+            }
+            for wid, w in self.workers.items()
+        }
+
+    def health_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-worker health view: EWMA batch latency, heartbeat age,
+        outcome counts/failure ratio, queue depth, straggler flag — the
+        ``GET /healthz`` body and the tpuml_worker_* gauge source."""
+        with self._lock:
+            return self._health_snapshot_locked()
+
+    def refresh_health_metrics(self) -> Dict[str, Dict[str, Any]]:
+        """Write the health snapshot into the ``tpuml_worker_*{wid=...}``
+        gauges and log straggler transitions. Called on metrics feedback,
+        at /metrics/prom scrape, and by the sweep; returns the snapshot so
+        callers (healthz) reuse one read. Snapshot AND gauge writes happen
+        under one lock hold: writing from a stale snapshot could resurrect
+        a concurrently-removed worker's cells after _drop_worker_gauges
+        already cleaned them — permanently, since refresh only writes
+        registered workers."""
+        with self._lock:
+            snap = self._health_snapshot_locked()
+            for wid, h in snap.items():
+                if h["ewma_batch_s"] is not None:
+                    gauge_set(
+                        "tpuml_worker_ewma_batch_seconds", h["ewma_batch_s"],
+                        wid=wid,
+                    )
+                gauge_set(
+                    "tpuml_worker_heartbeat_age_seconds", h["heartbeat_age_s"],
+                    wid=wid,
+                )
+                gauge_set(
+                    "tpuml_worker_failure_ratio", h["failure_ratio"], wid=wid
+                )
+                gauge_set("tpuml_worker_queue_depth", h["queue_depth"], wid=wid)
+                gauge_set(
+                    "tpuml_worker_straggler",
+                    1.0 if h["straggler"] else 0.0,
+                    wid=wid,
+                )
+            current = {wid for wid, h in snap.items() if h["straggler"]}
+            newly_flagged = sorted(current - self._flagged)
+            recovered = sorted(self._flagged - current)
+            self._flagged = current
+        for wid in newly_flagged:
+            logger.warning(
+                "Worker %s flagged as straggler (batch EWMA %.3fs vs peers); "
+                "placement now carries a +%.0fs advisory penalty",
+                wid, snap[wid]["ewma_batch_s"], self.cfg.straggler_penalty_s,
+            )
+        for wid in recovered:
+            logger.info("Worker %s no longer a straggler", wid)
+        return snap
+
+    def _drop_worker_gauges(self, worker_id: str) -> None:
+        """A dead/unsubscribed worker must stop being exposed: remove its
+        labeled cells from every per-worker gauge family."""
+        from ..obs import REGISTRY
+
+        for name in (
+            "tpuml_worker_ewma_batch_seconds",
+            "tpuml_worker_heartbeat_age_seconds",
+            "tpuml_worker_failure_ratio",
+            "tpuml_worker_queue_depth",
+            "tpuml_worker_straggler",
+        ):
+            g = REGISTRY.get(name)
+            if g is not None and hasattr(g, "remove"):
+                g.remove(wid=worker_id)
+        self._flagged.discard(worker_id)
+
     # ---------------- placement ----------------
 
     def place(self, task: Dict[str, Any]) -> Optional[str]:
@@ -151,9 +300,19 @@ class PlacementEngine:
                     mem_mb,
                 )
                 eligible = list(self.workers.values())
+            # straggler consumption is ADVISORY: a flat score penalty on
+            # flagged workers only — eligibility, fallback, and the score
+            # formula for healthy workers are untouched. Reads the flag
+            # set maintained by refresh_health_metrics (feedback/scrape/
+            # sweep) — recomputing peer medians on every placement would
+            # put O(W^2 log W) work on the hot path this module times.
+            stragglers = self._flagged
+            penalty = self.cfg.straggler_penalty_s
             best = min(
                 eligible,
-                key=lambda w: w.effective_finish_time() + est / max(w.speed_factor, 1e-3),
+                key=lambda w: w.effective_finish_time()
+                + est / max(w.speed_factor, 1e-3)
+                + (penalty if w.worker_id in stragglers else 0.0),
             )
             best.load_seconds += est
             best.mem_load_mb += mem_mb
@@ -205,8 +364,23 @@ class PlacementEngine:
                         + self.cfg.speed_ema_alpha * ratio,
                     ),
                 )
+            # every subtask of a batch reports the SAME batch wall time, so
+            # the health EWMA absorbs it once per batch — only the primary
+            # message updates (messages without the marker, e.g. synthetic
+            # feedback in tests, count as primary)
+            batch_once = msg.get("batch_primary") is not False
+            if actual is not None and batch_once:
+                a = self.cfg.health_ema_alpha
+                w.ewma_batch_s = (
+                    actual
+                    if w.ewma_batch_s is None
+                    else (1 - a) * w.ewma_batch_s + a * actual
+                )
+                w.n_batches += 1
         if actual is not None:
             self.predictor.observe(msg, actual)
+            if batch_once:
+                self.refresh_health_metrics()
 
     # ---------------- failure detection ----------------
 
@@ -240,7 +414,10 @@ class PlacementEngine:
                 self.cfg.dead_after_s,
                 len(w.tasks_queue),
             )
+            self._drop_worker_gauges(w.worker_id)
             self._requeue(w.tasks_queue)
+        if dead:
+            self.refresh_health_metrics()
         return [w.worker_id for w in dead]
 
     def _monitor_loop(self) -> None:

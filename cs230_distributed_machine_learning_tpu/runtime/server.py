@@ -46,11 +46,14 @@ _DASHBOARD_HTML = """<!doctype html>
 <h1>tpuml coordinator</h1>
 <div id="meta">health: <span id="health">…</span> · refreshed <span id="ts">never</span>
  · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code>
- <code>/metrics/prom</code> <code>/trace/&lt;job_id&gt;</code></div>
+ <code>/metrics/prom</code> <code>/trace/&lt;job_id&gt;</code> <code>/cost/&lt;job_id&gt;</code>
+ <code>/healthz</code></div>
 <h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
 <th>status</th><th>done</th><th>failed</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
 <h2>Latest job trace</h2>
 <div id="trace" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no trace yet</div>
+<h2>Latest job cost</h2>
+<div id="cost" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no cost data yet</div>
 <h2>Workers</h2><table id="workers"><thead></thead><tbody></tbody></table>
 <h2>Queues</h2><table id="queues"><thead></thead><tbody></tbody></table>
 <h2>Supervised agents</h2><table id="sup"><thead></thead><tbody></tbody></table>
@@ -112,6 +115,33 @@ function renderTrace(el, data){
         `<span style="width:80px;text-align:right">${((n.end - n.start) * 1000).toFixed(1)} ms</span></div>`;
     }).join("");
 }
+// SI-ish magnitude formatter for FLOP/byte counts
+const fmt = n => n == null ? "\\u2013"
+  : n >= 1e12 ? (n / 1e12).toFixed(2) + " T"
+  : n >= 1e9 ? (n / 1e9).toFixed(2) + " G"
+  : n >= 1e6 ? (n / 1e6).toFixed(2) + " M"
+  : String(Math.round(n));
+const pct = v => v == null ? "\\u2013" : (100 * v).toFixed(1) + "%";
+// per-job device cost report (GET /cost/<job_id>): totals line + one row
+// per executed (dataset, model) group
+function renderCost(el, c){
+  if (!c || !c.n_groups){ el.textContent = "no cost data yet"; return; }
+  el.innerHTML =
+    `<div style="color:#666">job <code>${esc(c.job_id)}</code> · ` +
+    `${(c.device_seconds || 0).toFixed(3)} device-s · ` +
+    `model FLOPs ${fmt(c.model_flops)} · bytes ${fmt(c.bytes_accessed)} · ` +
+    `MFU ${c.mfu == null ? "n/a" : pct(c.mfu)}</div>` +
+    `<table><thead><tr><th>model</th><th>dataset</th><th>trials</th>` +
+    `<th>device-s</th><th>FLOPs</th><th>bytes</th><th>MFU</th>` +
+    `<th>HBM peak</th></tr></thead><tbody>` +
+    c.groups.map(g => `<tr><td>${esc(g.model_type)}</td>` +
+      `<td>${esc(g.dataset_id)}</td><td>${esc(g.n_subtasks)}</td>` +
+      `<td>${(g.device_seconds || 0).toFixed(3)}</td>` +
+      `<td>${fmt(g.model_flops != null ? g.model_flops : g.xla_flops)}</td>` +
+      `<td>${fmt(g.bytes_accessed)}</td><td>${pct(g.mfu)}</td>` +
+      `<td>${fmt(g.hbm_peak_bytes)}</td></tr>`).join("") +
+    `</tbody></table>`;
+}
 async function tick(){
   const [h, jobs, workers, queues, sup] = await Promise.all(
     ["/health", "/jobs", "/workers", "/queues", "/supervisor"].map(get));
@@ -131,6 +161,8 @@ async function tick(){
   const latest = Array.isArray(jobs) && jobs.length ? jobs[0].job_id : null;
   renderTrace(document.getElementById("trace"),
               latest ? await get(`/trace/${latest}`) : null);
+  renderCost(document.getElementById("cost"),
+             latest ? await get(`/cost/${latest}`) : null);
   document.getElementById("ts").textContent = new Date().toLocaleTimeString();
 }
 tick(); setInterval(tick, 2000);
@@ -168,10 +200,13 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/dashboard", endpoint="dashboard", methods=["GET"]),
             # observability plane (docs/OBSERVABILITY.md): Prometheus
             # exposition of the unified metrics registry, per-job span
-            # trees, and the agents' span-shipping ingest
+            # trees, the agents' span-shipping ingest, the per-job device
+            # cost report, and the deep-health probe
             Rule("/metrics/prom", endpoint="metrics_prom", methods=["GET"]),
             Rule("/trace/<jid>", endpoint="trace", methods=["GET"]),
             Rule("/trace_spans/<wid>", endpoint="trace_spans", methods=["POST"]),
+            Rule("/cost/<jid>", endpoint="cost", methods=["GET"]),
+            Rule("/healthz", endpoint="healthz", methods=["GET"]),
             # worker-agent control plane (reference scheduler.py:95-159)
             Rule("/subscribe", endpoint="subscribe", methods=["POST"]),
             Rule("/unsubscribe/<wid>", endpoint="unsubscribe", methods=["POST"]),
@@ -224,7 +259,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /dashboard  (HTML)",
                     "GET  /metrics/prom  (Prometheus exposition)",
                     "GET  /trace/<job_id>  (span tree)",
+                    "GET  /cost/<job_id>  (device cost report)",
                     "GET  /health",
+                    "GET  /healthz  (deep health: device, workers, stragglers)",
                 ],
             }
         )
@@ -292,13 +329,82 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return _json(coord.job_metrics(sid, jid))
 
     def metrics_prom(request):
-        # refresh point-in-time gauges at scrape time
+        # refresh point-in-time gauges at scrape time: fleet size, the
+        # per-worker health families, and local-device HBM
         if coord.cluster is not None:
             gauge_set("tpuml_workers_alive", len(coord.cluster.engine.workers))
+            coord.cluster.engine.refresh_health_metrics()
+        from .executor import record_hbm_gauges
+
+        record_hbm_gauges()
         return Response(
             render_prometheus(),
             content_type="text/plain; version=0.0.4; charset=utf-8",
         )
+
+    def cost(request, jid):
+        """Per-job device cost report (docs/OBSERVABILITY.md): device-
+        seconds, total FLOPs/bytes, HBM high-water, per-group MFU."""
+        report = coord.job_cost(jid)
+        if report is None:
+            return _json(
+                {"status": "error", "message": f"no job {jid!r}"}, status=404
+            )
+        return _json(report)
+
+    def healthz(request):
+        """Deep health, beyond /health's liveness ping: local device
+        reachability + memory, per-worker health (EWMA batch latency,
+        heartbeat age, failure ratio, queue depth), and the flagged
+        straggler list. Always HTTP 200; ``status`` says ok/degraded."""
+        out = {"status": "ok", "obs_enabled": obs_enabled()}
+        try:
+            import jax
+
+            from ..utils.flops import device_memory_stats
+
+            devices = jax.local_devices()
+            dev = {
+                "reachable": True,
+                "platform": devices[0].platform,
+                "n_devices": len(devices),
+                "device_kind": str(getattr(devices[0], "device_kind", "")),
+            }
+            stats = device_memory_stats()
+            mem = {
+                k: stats[k]
+                for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                if k in stats
+            }
+            if mem:
+                dev["memory"] = mem
+        except Exception as e:  # noqa: BLE001 — unreachable backend IS the finding
+            dev = {"reachable": False, "error": str(e)}
+            out["status"] = "degraded"
+        out["device"] = dev
+        if coord.cluster is not None:
+            snap = coord.cluster.engine.refresh_health_metrics()
+            out["n_workers"] = len(snap)
+            out["workers"] = snap
+            out["queue_depths"] = {
+                wid: h["queue_depth"] for wid, h in snap.items()
+            }
+            out["stragglers"] = sorted(
+                wid for wid, h in snap.items() if h["straggler"]
+            )
+            if out["stragglers"] or not snap:
+                out["status"] = "degraded"
+        sup = getattr(coord, "agent_supervisor", None)
+        if sup is not None:
+            slots = sup.status()
+            out["agent_slots"] = {
+                "alive": sum(1 for s in slots if s["alive"]),
+                "total": len(slots),
+                "gave_up": sum(1 for s in slots if s["gave_up"]),
+            }
+            if slots and out["agent_slots"]["gave_up"] == len(slots):
+                out["status"] = "degraded"
+        return _json(out)
 
     def trace(request, jid):
         tid = TRACER.trace_for_job(jid)

@@ -60,6 +60,19 @@ class SchedulerConfig:
     speed_factor_min: float = 0.2
     speed_factor_max: float = 5.0
     algo_weights: dict = dataclasses.field(default_factory=dict)
+    # ---- per-worker health telemetry (docs/OBSERVABILITY.md) ----
+    # EWMA smoothing for a worker's batch wall time
+    health_ema_alpha: float = 0.2
+    # a worker is a straggler when its batch EWMA exceeds factor x the
+    # median EWMA of its peers (each judged against the OTHERS' median, so
+    # two-worker pools can flag too), after its EWMA has absorbed at least
+    # min_batches BATCHES (outcomes arrive per subtask — counting them
+    # would let one cold multi-subtask batch satisfy the guard)
+    straggler_factor: float = 3.0
+    straggler_min_batches: int = 2
+    # advisory placement-score penalty (seconds) added to flagged
+    # stragglers — eligibility and fallback semantics are untouched
+    straggler_penalty_s: float = 30.0
 
 
 @dataclasses.dataclass

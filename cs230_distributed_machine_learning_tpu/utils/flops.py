@@ -49,6 +49,19 @@ def device_peak_flops() -> Optional[float]:
     return 197e12 if dev.platform == "tpu" else None
 
 
+def device_memory_stats() -> Dict[str, Any]:
+    """``memory_stats()`` of local device 0, ``{}`` when the backend
+    exposes none (CPU) or no device is reachable. The one shared reader
+    behind the HBM gauge, ``TrialRunResult.hbm_peak_bytes``, and
+    ``GET /healthz`` — key names and the device-0 policy live here only."""
+    import jax
+
+    try:
+        return dict(jax.local_devices()[0].memory_stats() or {})
+    except Exception:  # noqa: BLE001 — stats are best-effort everywhere
+        return {}
+
+
 def analytical_flops(
     kernel: Any,
     static: Dict[str, Any],
@@ -79,10 +92,14 @@ def stratified_by(population, key_fn, n_samples: int):
     return [srt[i] for i in pos]
 
 
-def mfu(flops: Optional[float], wall_s: float) -> Optional[float]:
+def mfu(
+    flops: Optional[float], wall_s: float, n_devices: int = 1
+) -> Optional[float]:
     """Achieved fraction of device peak; None off-accelerator or without an
-    analytical FLOPs figure."""
+    analytical FLOPs figure. ``n_devices`` scales the peak for work that
+    ran across a mesh — whole-mesh FLOPs over a single chip's peak would
+    report N x reality."""
     peak = device_peak_flops()
     if flops is None or peak is None or wall_s <= 0:
         return None
-    return flops / wall_s / peak
+    return flops / wall_s / (peak * max(int(n_devices), 1))

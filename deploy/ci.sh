@@ -6,14 +6,37 @@
 #   2. tier-1 fast suite — the ROADMAP.md verify command: pytest on the
 #      virtual 8-device CPU mesh, slow (subprocess/chaos/minutes-long)
 #      suites excluded.
+# On a RED suite the trace/metric record of the run is preserved under
+# $CI_ARTIFACTS_DIR (default ci-artifacts/) so failures are diagnosable
+# from the span journal and a Prometheus snapshot instead of rerun
+# archaeology; ci.yml uploads the directory as a workflow artifact.
 # Wall time of the fast suite on the dev box is recorded in
 # docs/STATUS.md; keep the two in sync when it moves.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+ART_DIR="${CI_ARTIFACTS_DIR:-ci-artifacts}"
+
 echo "== lint gate: python -m compileall =="
 python -m compileall -q cs230_distributed_machine_learning_tpu tests benchmarks
 
 echo "== tier-1 fast suite (JAX_PLATFORMS=cpu, -m 'not slow') =="
+# CS230_JOURNAL_DIR: every span of the whole run lands in ONE journal
+# (tests re-root storage per test, which would scatter-then-delete it);
+# CS230_METRICS_SNAPSHOT: conftest dumps the suite process's registry in
+# Prometheus text format at session end when the run failed.
+mkdir -p "$ART_DIR"
+rc=0
+CS230_JOURNAL_DIR="$ART_DIR/journal" \
+CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
 JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-  --continue-on-collection-errors -p no:cacheprovider
+  --continue-on-collection-errors -p no:cacheprovider || rc=$?
+
+if [ "$rc" -eq 0 ]; then
+  # green run: drop the artifacts (only red runs need the forensic record)
+  rm -rf "$ART_DIR"
+else
+  echo "== suite failed (rc=$rc); trace/metric record kept in $ART_DIR =="
+  ls -la "$ART_DIR" "$ART_DIR/journal" 2>/dev/null || true
+fi
+exit "$rc"

@@ -48,3 +48,21 @@ def eight_device_mesh():
     from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
 
     return trial_mesh()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """CI forensics (deploy/ci.sh): on a red run, snapshot this process's
+    metrics registry in Prometheus text format so the failed suite's
+    counters/histograms ride the workflow artifact next to the span
+    journal (which CS230_JOURNAL_DIR already collects)."""
+    path = os.environ.get("CS230_METRICS_SNAPSHOT")
+    if not path or exitstatus == 0:
+        return
+    try:
+        from cs230_distributed_machine_learning_tpu.obs import render_prometheus
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(render_prometheus())
+    except Exception:  # noqa: BLE001 — forensics must not mask the failure
+        pass

@@ -122,6 +122,10 @@ def test_gbt_learning_rate_is_traced(iris_data):
     assert s1 > s0  # lr=0.001 with 20 stages barely moves off the prior
 
 
+@pytest.mark.slow  # ~31 s on the tier-1 CPU box: full grid job through the
+# client pipeline — the end-to-end path is already covered by the faster
+# LogReg jobs in test_end_to_end/test_server (tier-1 870 s budget,
+# docs/STATUS.md round 8)
 def test_forest_grid_through_pipeline():
     from sklearn.ensemble import RandomForestClassifier
     from sklearn.model_selection import GridSearchCV
@@ -185,6 +189,8 @@ def test_deep_decision_tree_parity(deep_data, monkeypatch):
     assert m["mean_cv_score"] > sk_cv - 0.06, (m["mean_cv_score"], sk_cv)
 
 
+@pytest.mark.slow  # ~71 s on the tier-1 CPU box (deep-arena CV against a
+# real sklearn forest); green standalone — tier-1 870 s budget
 def test_deep_forest_parity(deep_data, monkeypatch):
     from sklearn.ensemble import RandomForestClassifier
     from sklearn.model_selection import cross_val_score
@@ -200,6 +206,8 @@ def test_deep_forest_parity(deep_data, monkeypatch):
     assert m["mean_cv_score"] > sk_cv - 0.06, (m["mean_cv_score"], sk_cv)
 
 
+@pytest.mark.slow  # ~182 s on the tier-1 CPU box — the single heaviest
+# fast-suite test; green standalone — tier-1 870 s budget
 def test_deep_forest_chunked_matches_monolithic(deep_data, monkeypatch):
     """fold_in(t) per-tree streams make the chunked and monolithic deep
     fits identical (same guarantee the complete-tree path has)."""
