@@ -27,6 +27,7 @@ import uuid
 from typing import Any, Dict, Optional
 
 from ..obs import TRACE_HEADER, activate, new_trace_id, span
+from ..runtime.store import TERMINAL_STATUSES
 from ..utils.config import get_config
 from ..utils.serialization import json_safe
 from .introspection import extract_model_details
@@ -205,7 +206,7 @@ class MLTaskManager:
                 if bar is not None:
                     bar.n = int(_pct(job_status))
                     bar.refresh()
-                if job_status in ("completed", "failed"):
+                if job_status in TERMINAL_STATUSES:
                     self.result = status.get("job_result")
                     return status
                 if self._coordinator is None:
@@ -231,7 +232,7 @@ class MLTaskManager:
             return None
 
     def _finish_stream(self, last: Optional[Dict[str, Any]], timeout: float):
-        if last is None or last.get("job_status") not in ("completed", "failed"):
+        if last is None or last.get("job_status") not in TERMINAL_STATUSES:
             raise TimeoutError(
                 f"Job {self.job_id} stream ended without completion "
                 f"(timeout {timeout}s)"
@@ -256,7 +257,7 @@ class MLTaskManager:
                 if bar is not None:
                     bar.n = int(_pct(progress.get("job_status")))
                     bar.refresh()
-                if progress.get("job_status") in ("completed", "failed"):
+                if progress.get("job_status") in TERMINAL_STATUSES:
                     break
                 if time.time() > deadline:
                     raise TimeoutError(
@@ -305,7 +306,7 @@ class MLTaskManager:
                 if bar is not None:
                     bar.n = int(_pct(event.get("job_status")))
                     bar.refresh()
-                if event.get("job_status") in ("completed", "failed"):
+                if event.get("job_status") in TERMINAL_STATUSES:
                     break
                 if time.time() > deadline:
                     raise TimeoutError(
@@ -390,7 +391,7 @@ class MLTaskManager:
 
 
 def _pct(job_status) -> float:
-    if job_status == "completed":
+    if job_status in ("completed", "completed_with_failures"):
         return 100.0
     if isinstance(job_status, str) and job_status.endswith("%"):
         try:

@@ -123,7 +123,31 @@ def register_catalog() -> None:
     c("tpuml_subtasks_failed_total", "Subtask executions that failed")
     c(
         "tpuml_subtasks_requeued_total",
-        "Subtasks requeued off a dead/unsubscribed worker",
+        "Subtasks requeued off a dead/unsubscribed/evicted worker",
+    )
+    # ---- fault-tolerance layer (docs/ROBUSTNESS.md) ----
+    c(
+        "tpuml_subtasks_retried_total",
+        "Subtask re-dispatches by the fault-tolerance layer, labeled by "
+        "reason (failure|lease)",
+    )
+    c(
+        "tpuml_subtasks_quarantined_total",
+        "Subtasks quarantined after exhausting their retry budget or "
+        "killing too many worker backends",
+    )
+    c(
+        "tpuml_speculative_launched_total",
+        "Speculative (backup) duplicates launched for straggling subtasks",
+    )
+    c(
+        "tpuml_speculative_won_total",
+        "Speculative duplicates whose result was accepted first",
+    )
+    c(
+        "tpuml_speculative_wasted_total",
+        "Duplicate results dropped for subtasks that were speculated "
+        "(the losing copy's work)",
     )
     c("tpuml_agent_polls_total", "GET /next_tasks long-polls served")
     c(
@@ -212,6 +236,11 @@ def register_catalog() -> None:
     g(
         "tpuml_worker_straggler",
         "1 while a worker is flagged as a straggler, labeled by wid",
+    )
+    g(
+        "tpuml_worker_breaker_state",
+        "Circuit-breaker state per worker, labeled by wid (0 closed, "
+        "1 half-open; evicted workers' cells are removed)",
     )
 
 

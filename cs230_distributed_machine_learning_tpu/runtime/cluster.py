@@ -28,6 +28,7 @@ from ..obs import counter_inc, gauge_set, observe, process_token
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .executor import DeviceLostError, LocalExecutor
+from .faults import AttemptLedger
 from .queue import TopicBus
 from .scheduler import TOPIC_TASKS, TOPIC_TRAIN, PlacementEngine
 
@@ -93,10 +94,15 @@ class ExecutorWorker:
                 return
             def on_result(stid, status, result):
                 # in-process workers bypass push_result, so the engine's
-                # per-worker failure accounting hooks here
-                self.cluster.engine.record_outcome(
-                    self.worker_id, status != "failed"
-                )
+                # per-worker failure accounting hooks here. worker_id rides
+                # the result so the coordinator's retry path can exclude
+                # the failing worker; a failed attempt emits no metrics
+                # message, so the engine's books are released here instead.
+                result = {**(result or {}), "worker_id": self.worker_id}
+                failed = status == "failed"
+                self.cluster.engine.record_outcome(self.worker_id, not failed)
+                if failed:
+                    self.cluster.engine.release_task(self.worker_id, stid)
                 self.cluster.bus.publish(TOPIC_RESULT, result, key=stid)
 
             try:
@@ -119,6 +125,9 @@ class ExecutorWorker:
                     "Worker %s lost its device backend; leaving the pool",
                     self.worker_id,
                 )
+                # poison correlation first: a subtask on its Nth killed
+                # backend must be quarantined, not requeued to kill N+1
+                self.cluster.note_device_loss(self.worker_id, batch)
                 self.cluster.kill_executor(self.worker_id)
                 return
             except Exception:  # noqa: BLE001
@@ -128,7 +137,14 @@ class ExecutorWorker:
 class ClusterRuntime:
     def __init__(self, *, cache=None, predictor=None):
         self.bus = TopicBus()
-        self.engine = PlacementEngine(bus=self.bus, predictor=predictor)
+        #: shared attempt/exclusion/poison accounting: the engine bumps it
+        #: on lease reclaims/requeues/speculation, the coordinator on
+        #: failure retries; one ledger keeps attempt ids monotonic
+        self.ledger = AttemptLedger()
+        self.engine = PlacementEngine(
+            bus=self.bus, predictor=predictor, ledger=self.ledger
+        )
+        self.engine.on_evict = self._on_worker_evicted
         self.cache = cache
         self.workers: Dict[str, ExecutorWorker] = {}
         self._remote_subs: Dict[str, Any] = {}
@@ -163,6 +179,55 @@ class ClusterRuntime:
         worker = self.workers.pop(worker_id, None)
         if worker is not None:
             worker.kill()
+
+    def _on_worker_evicted(self, worker_id: str) -> None:
+        """Breaker eviction teardown: stop the in-process worker threads
+        and/or close the remote long-poll subscription — the engine already
+        removed the WorkerState and requeues the tasks."""
+        worker = self.workers.pop(worker_id, None)
+        if worker is not None:
+            worker.kill()
+        sub = self._remote_subs.pop(worker_id, None)
+        if sub is not None:
+            sub.close()
+
+    def note_device_loss(self, worker_id: str, tasks: List[Dict[str, Any]]) -> None:
+        """Correlate a backend loss with the subtasks that rode the dying
+        batch. A subtask that has now killed ``poison_kill_threshold``
+        worker backends is poisoned: release it from the dying worker's
+        queue (so the dead-worker sweep does NOT requeue it to kill a
+        third) and publish a synthetic failed result the coordinator
+        quarantines on ingest. Below the threshold, nothing happens here —
+        the task stays queued for the normal sweep requeue."""
+        threshold = get_config().scheduler.poison_kill_threshold
+        for task in tasks:
+            stid = task.get("subtask_id")
+            if not stid:
+                continue
+            kills = self.ledger.note_device_loss(stid)
+            if kills < threshold:
+                continue
+            logger.error(
+                "Subtask %s killed %d worker backends; poisoning it instead "
+                "of requeueing", stid, kills,
+            )
+            self.engine.release_task(worker_id, stid)
+            self.bus.publish(
+                TOPIC_RESULT,
+                {
+                    "subtask_id": stid,
+                    "job_id": task.get("job_id"),
+                    "model_type": task.get("model_type"),
+                    "parameters": task.get("parameters"),
+                    "status": "failed",
+                    "error": f"subtask killed {kills} worker backends "
+                             "(device loss correlation)",
+                    "error_kind": "device_lost",
+                    "attempt": int(task.get("attempt") or 0),
+                    "worker_id": worker_id,
+                },
+                key=stid,
+            )
 
     # ---------------- remote agents (DCN control plane) ----------------
     # A remote WorkerAgent (runtime/agent.py) on another host registers here
@@ -211,7 +276,12 @@ class ClusterRuntime:
         # reaches the job store / client-visible results
         src_pid = result.pop("obs_pid", None)
         ok = result.get("status") != "failed"
+        result.setdefault("worker_id", worker_id)
         self.engine.record_outcome(worker_id, ok)
+        if not ok:
+            # failed attempts emit no metrics message: release the engine's
+            # books (queue entry, load, lease) for the reporting worker
+            self.engine.release_task(worker_id, result.get("subtask_id"))
         # count the outcome coordinator-side so /metrics/prom sees subtasks
         # executed in other processes — but not twice for an agent sharing
         # THIS process (its executor already counted into the shared

@@ -27,7 +27,7 @@ from ..utils.serialization import json_safe
 from .artifacts import save_artifact
 from .executor import LocalExecutor
 from .queue import TopicBus
-from .store import JobStore
+from .store import TERMINAL_STATUSES, JobStore
 from .subtasks import create_subtasks
 
 logger = get_logger("tpuml.coordinator")
@@ -69,8 +69,30 @@ class Coordinator:
         self._artifact_lock = threading.Lock()
         self._artifact_specs: Dict[Any, Dict[str, Any]] = {}
         self._artifact_paths: Dict[Any, str] = {}
+        if cluster is not None:
+            # journal every attempt issue (lease reclaim / retry / requeue /
+            # speculation) into the job store so replay preserves budgets
+            cluster.ledger.on_attempt = self._journal_attempt
         if journal:
             self.resume_inflight()
+
+    def _journal_attempt(self, task: Dict[str, Any], entry, reason: str) -> None:
+        sid = task.get("session_id")
+        jid = task.get("job_id")
+        stid = task.get("subtask_id")
+        if not (sid and jid and stid):
+            return
+        try:
+            self.store.record_attempt(
+                sid, jid, stid,
+                attempt=entry.attempt,
+                failures=entry.failures,
+                excluded=entry.excluded,
+            )
+        except KeyError:
+            # a job this store never saw (foreign traffic on a shared
+            # cluster): nothing to journal
+            pass
 
     def resume_inflight(self) -> List[str]:
         """Re-dispatch jobs the journal shows as unfinished: replay restores
@@ -281,17 +303,40 @@ class Coordinator:
             )
 
     def _run_job_scheduled(self, sid, job_id, subtasks, on_result) -> List[Dict[str, Any]]:
-        """Dispatch through the placement engine and collect results from the
-        bus — the reference's consume_results thread (task_handler.py:18-68)
-        with at-least-once dedup."""
+        """Dispatch through the placement engine and collect results from
+        the bus — the reference's consume_results thread
+        (task_handler.py:18-68) — upgraded with the fault-tolerance layer
+        (docs/ROBUSTNESS.md):
+
+        - **at-least-once + dedup by attempt id**: the first terminal
+          COMPLETED result for a subtask wins; later duplicates (requeue
+          races, speculative losers) are dropped. A FAILED result only
+          counts against the retry budget when it belongs to the CURRENT
+          attempt — failures of superseded attempts are stale.
+        - **bounded retries with backoff**: a failed attempt is re-
+          dispatched up to ``retry_max_attempts`` total executions, with
+          exponential per-attempt backoff and the failing worker excluded.
+        - **poison quarantine**: a subtask that exhausts its budget — or
+          killed ``poison_kill_threshold`` worker backends — is accepted
+          as a quarantined failure; the job completes with partial results
+          instead of stalling.
+        """
         import queue as _q
 
+        cfg = self.config.scheduler
+        ledger = self.cluster.ledger
         wanted = {st["subtask_id"]: i for i, st in enumerate(subtasks)}
+        spec_by_id = {st["subtask_id"]: st for st in subtasks}
         results: List[Optional[Dict[str, Any]]] = [None] * len(subtasks)
+        #: failure retries awaiting their backoff: (due_ts, stamped task)
+        retry_due: List[tuple] = []
         sub = self.bus.subscribe("result", key_filter=lambda k: k in wanted)
         try:
             job = self.store.get_job(sid, job_id)
-            self.cluster.submit(subtasks, metadata=job.get("metadata") or None)
+            metadata = job.get("metadata") or None
+            for st in subtasks:
+                ledger.seed(st)
+            self.cluster.submit(subtasks, metadata=metadata)
             pending = set(wanted)
             # Progress-aware liveness, not a wall-clock deadline: a long job
             # whose executors are still productively computing must not be
@@ -302,20 +347,31 @@ class Coordinator:
             stall_grace = self.config.service.client_timeout_s
             # ownership proves placement, not computation: a wedged worker
             # whose heartbeat thread survives would hold its queue entry
-            # forever, so a generous hard bound restores eventual liveness
+            # forever. The lease layer normally reclaims those; a generous
+            # hard bound restores eventual liveness even with leases off.
             hard_deadline = time.time() + 20.0 * stall_grace
             last_progress = time.time()
             while pending:
-                if time.time() > hard_deadline:
+                now = time.time()
+                if now > hard_deadline:
                     raise TimeoutError(
                         f"{len(pending)} subtasks unfinished at the hard "
                         f"deadline ({20.0 * stall_grace:.0f}s)"
                     )
+                if retry_due:
+                    due = [t for ts, t in retry_due if ts <= now]
+                    if due:
+                        retry_due = [
+                            (ts, t) for ts, t in retry_due if ts > now
+                        ]
+                        self.cluster.submit(due, metadata=metadata)
                 try:
                     stid, result = sub.get(timeout=0.5)
                 except _q.Empty:
                     if time.time() - last_progress > stall_grace:
-                        owned: set = set()
+                        owned: set = {
+                            t["subtask_id"] for _, t in retry_due
+                        }  # backoff-parked retries count as owned
                         for q in self.cluster.engine.queue_snapshot().values():
                             owned.update(q)
                         if not (pending & owned):
@@ -326,15 +382,86 @@ class Coordinator:
                             )
                         last_progress = time.time()  # workers still own tasks
                     continue
+                result = result or {}
                 if stid not in pending:
-                    continue  # duplicate delivery after a requeue
-                pending.discard(stid)
-                results[wanted[stid]] = result
-                on_result(stid, result.get("status", "completed"), result)
+                    # duplicate delivery: a requeue race or the losing copy
+                    # of a speculative pair — dropped here, which IS the
+                    # cancellation ("first terminal result wins")
+                    if ledger.was_speculated(stid):
+                        counter_inc("tpuml_speculative_wasted_total")
+                    continue
+                if result.get("status", "completed") != "failed":
+                    pending.discard(stid)
+                    ledger.mark_done(stid)
+                    results[wanted[stid]] = result
+                    if result.get("speculative"):
+                        counter_inc("tpuml_speculative_won_total")
+                    on_result(stid, "completed", result)
+                    last_progress = time.time()
+                    continue
+                # ---- failed result: retry budget / quarantine ----
+                attempt = int(result.get("attempt") or 0)
+                if ledger.is_stale(stid, attempt):
+                    # a newer attempt (lease reclaim / speculation) owns
+                    # this subtask now; the old attempt's failure must not
+                    # consume budget
+                    continue
+                wid = result.get("worker_id")
+                entry = ledger.record_failure(stid, wid)
+                poisoned = entry.device_losses >= cfg.poison_kill_threshold
+                if poisoned or entry.failures >= cfg.retry_max_attempts:
+                    quarantined = {
+                        **result,
+                        "quarantined": True,
+                        "attempts": entry.failures,
+                        "quarantine_reason": (
+                            "poisoned" if poisoned else "retries_exhausted"
+                        ),
+                    }
+                    counter_inc("tpuml_subtasks_quarantined_total")
+                    logger.error(
+                        "Quarantining %s after %d failed attempts (%s): %s",
+                        stid, entry.failures,
+                        quarantined["quarantine_reason"],
+                        result.get("error"),
+                    )
+                    with span("job.quarantine", job_id=job_id,
+                              subtask_id=stid, attempts=entry.failures,
+                              reason=quarantined["quarantine_reason"]):
+                        pass
+                    pending.discard(stid)
+                    ledger.mark_done(stid)
+                    results[wanted[stid]] = quarantined
+                    on_result(stid, "failed", quarantined)
+                else:
+                    task = dict(spec_by_id[stid])
+                    task.pop("speculative", None)
+                    ledger.next_attempt(
+                        task, exclude_worker=wid, reason="failure"
+                    )
+                    backoff = min(
+                        cfg.retry_backoff_s * 2 ** max(entry.failures - 1, 0),
+                        cfg.retry_backoff_max_s,
+                    )
+                    counter_inc(
+                        "tpuml_subtasks_retried_total", reason="failure"
+                    )
+                    logger.warning(
+                        "Retrying %s (attempt %d/%d) in %.2fs, excluding "
+                        "worker %s",
+                        stid, task["attempt"], cfg.retry_max_attempts,
+                        backoff, wid,
+                    )
+                    with span("job.retry", job_id=job_id, subtask_id=stid,
+                              attempt=task["attempt"], backoff_s=backoff,
+                              excluded_worker=wid):
+                        pass
+                    retry_due.append((time.time() + backoff, task))
                 last_progress = time.time()
             return results  # type: ignore[return-value]
         finally:
             sub.close()
+            self.cluster.ledger.forget(wanted)
 
     def _aggregate(self, sid, job_id, subtasks, results) -> None:
         """Sort completed trials by mean_cv_score desc; best_result first
@@ -380,29 +507,51 @@ class Coordinator:
             st = next(s for s in subtasks if s["subtask_id"] == best["subtask_id"])
             with self._artifact_lock:
                 self._artifact_specs[(sid, job_id)] = st
-        self.store.finalize_job(
-            sid,
-            job_id,
-            json_safe(
+        final = {
+            "results": ranked,
+            "failed": failed,
+            "best_result": best,
+            "completion_time": time.time(),
+        }
+        # quarantine contract (docs/ROBUSTNESS.md): subtasks the retry
+        # layer gave up on surface as a structured report, and the job
+        # finalizes as ``completed_with_failures`` (partial results)
+        # instead of plain ``completed``. Direct-mode failures (no retry
+        # machinery ran, no ``quarantined`` stamp) keep the legacy
+        # ``completed`` + failed-list semantics.
+        quarantined = [r for r in failed if r.get("quarantined")]
+        if quarantined:
+            final["failed_subtasks"] = [
                 {
-                    "results": ranked,
-                    "failed": failed,
-                    "best_result": best,
-                    "completion_time": time.time(),
+                    "subtask_id": r.get("subtask_id"),
+                    "attempts": r.get("attempts"),
+                    "reason": r.get("quarantine_reason"),
+                    "error": r.get("error"),
                 }
-            ),
-        )
+                for r in quarantined
+            ]
+            logger.warning(
+                "Job %s completed with %d quarantined subtasks "
+                "(partial results)", job_id, len(quarantined),
+            )
+        self.store.finalize_job(sid, job_id, json_safe(final))
 
     # ------------- status / metrics / model (master.py:115-340 parity) -------------
 
     def check_status(self, sid: str, job_id: str) -> Dict[str, Any]:
         self._require_session(sid)
         progress = self.store.job_progress(sid, job_id)
-        if progress["job_status"] == "completed" and progress["job_result"]:
+        status = progress["job_status"]
+        if (
+            status in ("completed", "completed_with_failures")
+            and progress["job_result"]
+        ):
             result = progress["job_result"]
-            out = {"job_status": "completed", "job_result": result}
+            out = {"job_status": status, "job_result": result}
             if result.get("results") and len(result["results"]) > 1:
                 out["best_result"] = result.get("best_result")
+            if result.get("failed_subtasks"):
+                out["failed_subtasks"] = result["failed_subtasks"]
             return out
         return progress
 
@@ -413,7 +562,7 @@ class Coordinator:
         while True:
             progress = self.store.job_progress(sid, job_id)
             yield progress
-            if progress["job_status"] in ("completed", "failed"):
+            if progress["job_status"] in TERMINAL_STATUSES:
                 return
             time.sleep(tick)
 

@@ -228,7 +228,13 @@ class LocalExecutor:
                         "parameters": st["parameters"],
                         "status": "failed",
                         "error": str(e),
+                        # attempt-id stamp: the coordinator's retry/dedup
+                        # path must know WHICH attempt failed — a stale
+                        # attempt's failure must not consume retry budget
+                        "attempt": int(st.get("attempt") or 0),
                     }
+                    if st.get("speculative"):
+                        result["speculative"] = True
                     results[gi] = result
                     counter_inc("tpuml_subtasks_failed_total")
                     if on_result:
@@ -295,6 +301,17 @@ class LocalExecutor:
                     scoring=scoring,
                 )
         finished_at = time.time()
+        if self.fault_injector is not None and self.fault_injector.drop_batch_results(
+            self.executor_id
+        ):
+            # silent-worker chaos: the batch RAN (compute burned) but no
+            # result/metrics message ever leaves this executor — the lease
+            # layer must recover the subtasks (docs/ROBUSTNESS.md)
+            logger.warning(
+                "FaultInjector: dropping results of a %d-trial %s batch on %s",
+                len(idxs), model_type, self.executor_id,
+            )
+            return
         observe("tpuml_executor_dispatch_seconds", run.run_time_s)
         resources = sampler.averages()
         batch_cost = self._record_batch_cost(
@@ -319,8 +336,13 @@ class LocalExecutor:
                 "search_params": st.get("search_params"),
                 "training_time": per_trial_time,
                 "status": "completed",
+                # attempt-id stamp for result-ingest dedup under retries
+                # and speculative duplicates (docs/ROBUSTNESS.md)
+                "attempt": int(st.get("attempt") or 0),
                 **run.trial_metrics[j],
             }
+            if st.get("speculative"):
+                result["speculative"] = True
             if device_best_pos == j:
                 result["device_argmax"] = True
             if j == 0 and batch_cost is not None:
@@ -616,21 +638,34 @@ def _is_device_fatal(e: BaseException) -> bool:
 
 class FaultInjector:
     """Test/chaos hooks (SURVEY.md §5.3: 'add real fault injection hooks'):
-    delay a host's batches, fail N batches (task-level), drop results
-    silently, or poison the device backend (process-level) — immediately or
-    after N healthy batches (``device_lost_after``, the kill-mid-job chaos
-    scenario)."""
+    delay a host's batches, fail N batches (task-level), drop the results
+    of N batches silently (``drop_results`` — the compute runs but no
+    result or metrics message leaves the executor: the silent/hung-worker
+    scenario the lease layer recovers), or poison the device backend
+    (process-level) — immediately or after N healthy batches
+    (``device_lost_after``, the kill-mid-job chaos scenario).
+    ``only_worker=`` scopes every mode to one executor id, so a shared
+    injector can target a single worker deterministically."""
 
     def __init__(self, delay_s: float = 0.0, fail_batches: int = 0,
                  device_lost: bool = False,
-                 device_lost_after: Optional[int] = None):
+                 device_lost_after: Optional[int] = None,
+                 drop_results: int = 0,
+                 only_worker: Optional[str] = None):
         self.delay_s = delay_s
         self.fail_batches = fail_batches
         self.device_lost = device_lost
         self.device_lost_after = device_lost_after
+        self.drop_results = drop_results
+        self.only_worker = only_worker
         self._batches_seen = 0
 
+    def _targets(self, executor_id: str) -> bool:
+        return self.only_worker is None or executor_id == self.only_worker
+
     def before_batch(self, executor_id: str, model_type: str) -> None:
+        if not self._targets(executor_id):
+            return
         if self.delay_s > 0:
             time.sleep(self.delay_s)
         if self.device_lost or (
@@ -644,6 +679,17 @@ class FaultInjector:
             self.fail_batches -= 1
             raise RuntimeError(f"fault injection: simulated batch failure on {executor_id}")
         self._batches_seen += 1  # only batches that passed injection count
+
+    def drop_batch_results(self, executor_id: str) -> bool:
+        """True when this batch's results/metrics must be silently dropped
+        (consumes one ``drop_results`` budget unit). Called by the executor
+        after the batch ran, before any emission."""
+        if not self._targets(executor_id):
+            return False
+        if self.drop_results > 0:
+            self.drop_results -= 1
+            return True
+        return False
 
 
 def _np(y):

@@ -18,6 +18,25 @@ containers to mesh executors:
   gracefully (scheduler.py:120-139). Elastic join assigns monotonically
   increasing ids (scheduler_service.py:157-165).
 
+Beyond the reference, the fault-tolerance layer (docs/ROBUSTNESS.md):
+
+- **leases**: every placed subtask carries a deadline derived from the
+  runtime predictor's estimate (x ``lease_factor``, floored); the sweep
+  reclaims expired leases from LIVE but hung workers — the strictly
+  stronger form of the dead-worker detection above.
+- **speculative execution**: an in-flight subtask whose age exceeds the
+  peer-median batch EWMA x ``straggler_factor`` gets ONE duplicate on an
+  idle worker (Dean & Ghemawat's backup tasks); the coordinator's
+  result-ingest dedups by attempt id, first terminal result wins.
+- **circuit breaker**: a worker whose windowed failure ratio trips
+  ``breaker_failure_ratio`` is demoted to half-open (probe tasks only —
+  at most one in flight) and evicted after ``breaker_max_trips`` trips,
+  upgrading the advisory straggler penalty into an enforced state
+  machine.
+All re-executions are accounted through the shared
+:class:`~.faults.AttemptLedger` so attempt ids stay monotonic and
+journaled.
+
 The engine is transport-agnostic: it consumes/produces on the in-process
 TopicBus (runtime/queue.py) locally, and the same message schema rides DCN
 RPC for multi-host agents (runtime/agent.py).
@@ -33,12 +52,16 @@ from typing import Any, Callable, Dict, List, Optional
 from ..obs import counter_inc, gauge_set, observe, span
 from ..utils.config import get_config
 from ..utils.logging import get_logger
+from .faults import AttemptLedger
 from .predictor import RuntimePredictor
 
 logger = get_logger("tpuml.scheduler")
 
 TOPIC_TASKS = "tasks"
 TOPIC_TRAIN = "train"
+#: same name as cluster.TOPIC_RESULT — the sweep publishes synthetic
+#: failed results here when a subtask exhausts its lease budget
+TOPIC_RESULT = "result"
 
 
 @dataclasses.dataclass
@@ -53,7 +76,18 @@ class WorkerState:
     # per-task bookkeeping for feedback decrements
     task_est: Dict[str, float] = dataclasses.field(default_factory=dict)
     task_mem: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: per-task lease deadline (absolute time); expired leases on a LIVE
+    #: worker are reclaimed by the sweep (docs/ROBUSTNESS.md)
+    task_lease: Dict[str, float] = dataclasses.field(default_factory=dict)
+    #: per-task placement timestamp — the speculation age signal
+    task_placed_at: Dict[str, float] = dataclasses.field(default_factory=dict)
     alive: bool = True
+    # ---- circuit breaker (closed -> half_open -> evicted) ----
+    breaker_state: str = "closed"
+    breaker_trips: int = 0
+    #: outcome window since the last breaker transition
+    window_ok: int = 0
+    window_failed: int = 0
     # ---- health telemetry (docs/OBSERVABILITY.md "Worker health") ----
     #: EWMA of this worker's batch wall time (None until the first batch)
     ewma_batch_s: Optional[float] = None
@@ -77,11 +111,22 @@ class WorkerState:
 
 
 class PlacementEngine:
-    def __init__(self, bus=None, predictor: Optional[RuntimePredictor] = None):
+    def __init__(
+        self,
+        bus=None,
+        predictor: Optional[RuntimePredictor] = None,
+        ledger: Optional[AttemptLedger] = None,
+    ):
         cfg = get_config().scheduler
         self.cfg = cfg
         self.bus = bus
         self.predictor = predictor or RuntimePredictor()
+        #: attempt/exclusion/poison accounting, shared with the coordinator
+        #: when a ClusterRuntime wires both to one ledger
+        self.ledger = ledger if ledger is not None else AttemptLedger()
+        #: called with a worker id the breaker evicted — the cluster hooks
+        #: this to tear down the in-process worker / remote subscription
+        self.on_evict: Optional[Callable[[str], None]] = None
         self._lock = threading.RLock()
         self.workers: Dict[str, WorkerState] = {}
         self._next_id = 0
@@ -114,7 +159,7 @@ class PlacementEngine:
         if state is None:
             return []
         logger.info("Worker %s unsubscribed; requeueing %d tasks", worker_id, len(state.tasks_queue))
-        return self._requeue(state.tasks_queue)
+        return self._requeue(state.tasks_queue, from_worker=worker_id)
 
     def heartbeat(self, worker_id: str) -> bool:
         with self._lock:
@@ -150,15 +195,118 @@ class PlacementEngine:
     def record_outcome(self, worker_id: str, ok: bool) -> None:
         """Count one subtask outcome against a worker — the failure-rate
         input. Fed by the cluster's result paths (in-process worker
-        callbacks and remote /task_result ingest)."""
+        callbacks and remote /task_result ingest). Also drives the circuit
+        breaker: closed -> half-open on a tripped windowed failure ratio,
+        half-open -> closed on a successful probe, eviction after
+        ``breaker_max_trips`` trips (docs/ROBUSTNESS.md)."""
+        cfg = self.cfg
+        evict = False
         with self._lock:
             w = self.workers.get(worker_id)
             if w is None:
                 return
             if ok:
                 w.n_completed += 1
+                w.window_ok += 1
             else:
                 w.n_failed += 1
+                w.window_failed += 1
+            if cfg.breaker_failure_ratio <= 0:
+                return
+            if w.breaker_state == "half_open":
+                if ok:
+                    w.breaker_state = "closed"
+                    w.window_ok = w.window_failed = 0
+                    gauge_set(
+                        "tpuml_worker_breaker_state", 0.0, wid=worker_id
+                    )
+                    logger.info(
+                        "Worker %s breaker closed (probe succeeded)", worker_id
+                    )
+                else:
+                    w.breaker_trips += 1
+                    w.window_ok = w.window_failed = 0
+                    evict = w.breaker_trips >= cfg.breaker_max_trips
+                    logger.warning(
+                        "Worker %s breaker probe failed (trip %d/%d)",
+                        worker_id, w.breaker_trips, cfg.breaker_max_trips,
+                    )
+            else:
+                total = w.window_ok + w.window_failed
+                # bounded window: decay (halve) the counters once the
+                # window outgrows the trip threshold by 8x, so a long-
+                # healthy history cannot drown out a recent failure streak
+                # (1000 past successes must not require 1000 failures to
+                # trip). Halving preserves the ratio.
+                if total >= 8 * max(cfg.breaker_min_outcomes, 4):
+                    w.window_ok //= 2
+                    w.window_failed //= 2
+                    total = w.window_ok + w.window_failed
+                if (
+                    total >= cfg.breaker_min_outcomes
+                    and w.window_failed / total >= cfg.breaker_failure_ratio
+                ):
+                    w.breaker_state = "half_open"
+                    w.breaker_trips += 1
+                    w.window_ok = w.window_failed = 0
+                    gauge_set(
+                        "tpuml_worker_breaker_state", 1.0, wid=worker_id
+                    )
+                    logger.warning(
+                        "Worker %s breaker tripped -> half-open (probe tasks "
+                        "only; trip %d/%d)",
+                        worker_id, w.breaker_trips, cfg.breaker_max_trips,
+                    )
+                    evict = w.breaker_trips >= cfg.breaker_max_trips
+        if evict:
+            self.evict_worker(worker_id)
+
+    def release_task(self, worker_id: str, subtask_id: Optional[str]) -> bool:
+        """Clear a worker's bookkeeping for a subtask whose attempt ended
+        WITHOUT a metrics message (failed batches emit results only): queue
+        entry, load/memory reservation, lease, and placement stamp. No
+        speed-factor update — a failure carries no timing signal."""
+        if subtask_id is None:
+            return False
+        with self._lock:
+            w = self.workers.get(worker_id)
+            if w is None or subtask_id not in w.task_est:
+                return False
+            est = w.task_est.pop(subtask_id, 0.0)
+            mem = w.task_mem.pop(subtask_id, 0.0)
+            w.task_lease.pop(subtask_id, None)
+            w.task_placed_at.pop(subtask_id, None)
+            w.load_seconds = max(0.0, w.load_seconds - est)
+            w.mem_load_mb = max(0.0, w.mem_load_mb - mem)
+            w.tasks_queue = [
+                t for t in w.tasks_queue if t.get("subtask_id") != subtask_id
+            ]
+        return True
+
+    def evict_worker(self, worker_id: str, reason: str = "circuit breaker") -> List[Dict[str, Any]]:
+        """Remove a worker the breaker gave up on; requeue its queued tasks
+        onto survivors and notify the runtime via ``on_evict`` so transport
+        state (in-process worker threads / remote long-poll subscriptions)
+        is torn down too."""
+        with self._lock:
+            state = self.workers.pop(worker_id, None)
+            gauge_set("tpuml_workers_alive", len(self.workers))
+        if state is None:
+            return []
+        logger.warning(
+            "Worker %s evicted (%s); requeueing %d tasks",
+            worker_id, reason, len(state.tasks_queue),
+        )
+        self._drop_worker_gauges(worker_id)
+        hook = self.on_evict
+        if hook is not None:
+            try:
+                hook(worker_id)
+            except Exception:  # noqa: BLE001 — teardown must not block requeue
+                logger.exception("on_evict hook failed for %s", worker_id)
+        requeued = self._requeue(state.tasks_queue, from_worker=worker_id)
+        self.refresh_health_metrics()
+        return requeued
 
     def _straggler_ids_locked(self) -> set:
         """Workers whose batch EWMA exceeds ``straggler_factor`` x the
@@ -202,6 +350,8 @@ class PlacementEngine:
                 "load_seconds": w.load_seconds,
                 "speed_factor": w.speed_factor,
                 "straggler": wid in stragglers,
+                "breaker_state": w.breaker_state,
+                "breaker_trips": w.breaker_trips,
             }
             for wid, w in self.workers.items()
         }
@@ -243,6 +393,11 @@ class PlacementEngine:
                     1.0 if h["straggler"] else 0.0,
                     wid=wid,
                 )
+                gauge_set(
+                    "tpuml_worker_breaker_state",
+                    1.0 if h["breaker_state"] == "half_open" else 0.0,
+                    wid=wid,
+                )
             current = {wid for wid, h in snap.items() if h["straggler"]}
             newly_flagged = sorted(current - self._flagged)
             recovered = sorted(self._flagged - current)
@@ -268,6 +423,7 @@ class PlacementEngine:
             "tpuml_worker_failure_ratio",
             "tpuml_worker_queue_depth",
             "tpuml_worker_straggler",
+            "tpuml_worker_breaker_state",
         ):
             g = REGISTRY.get(name)
             if g is not None and hasattr(g, "remove"):
@@ -300,6 +456,31 @@ class PlacementEngine:
                     mem_mb,
                 )
                 eligible = list(self.workers.values())
+            # excluded-worker memory (retries must not land on the worker
+            # that just failed/hung the task) — a preference, not a gate:
+            # when only excluded workers remain, liveness wins
+            excluded = set(task.get("excluded_workers") or ())
+            if excluded:
+                non_excluded = [
+                    w for w in eligible if w.worker_id not in excluded
+                ]
+                if non_excluded:
+                    eligible = non_excluded
+                else:
+                    logger.warning(
+                        "Every eligible worker is excluded for %s; "
+                        "falling back to the excluded pool",
+                        task.get("subtask_id"),
+                    )
+            # circuit breaker: a half-open worker takes PROBE tasks only —
+            # at most one in flight (empty queue). If no closed or
+            # probe-ready worker exists, fall back rather than stall.
+            breaker_ok = [
+                w for w in eligible
+                if w.breaker_state != "half_open" or not w.tasks_queue
+            ]
+            if breaker_ok:
+                eligible = breaker_ok
             # straggler consumption is ADVISORY: a flat score penalty on
             # flagged workers only — eligibility, fallback, and the score
             # formula for healthy workers are untouched. Reads the flag
@@ -320,6 +501,18 @@ class PlacementEngine:
             stid = task.get("subtask_id")
             best.task_est[stid] = est
             best.task_mem[stid] = mem_mb
+            now = time.time()
+            best.task_placed_at[stid] = now
+            if self.cfg.lease_factor > 0:
+                # lease covers the PREDICTED completion time on this worker
+                # — queue wait included (effective_finish_time already
+                # absorbed this task's estimate above), speed-adjusted —
+                # so deep queues don't expire healthy leases; the floor
+                # absorbs cold-start noise
+                best.task_lease[stid] = now + max(
+                    self.cfg.lease_floor_s,
+                    self.cfg.lease_factor * best.effective_finish_time(),
+                )
             wid = best.worker_id
         elapsed = time.perf_counter() - t_place
         observe("tpuml_scheduler_placement_seconds", elapsed)
@@ -328,7 +521,8 @@ class PlacementEngine:
         if tid:
             # the decision already ran: back-date the span over it
             with span("schedule.place", trace_id=tid, parent_id=None,
-                      subtask_id=stid, worker=wid, est_runtime_s=est) as sp:
+                      subtask_id=stid, worker=wid, est_runtime_s=est,
+                      attempt=int(task.get("attempt") or 0)) as sp:
                 sp.start = time.time() - elapsed
         if self.bus is not None:
             self.bus.publish(TOPIC_TRAIN, task, key=wid)
@@ -351,6 +545,8 @@ class PlacementEngine:
                 return
             est = w.task_est.pop(stid, 0.0)
             mem = w.task_mem.pop(stid, 0.0)
+            w.task_lease.pop(stid, None)
+            w.task_placed_at.pop(stid, None)
             w.load_seconds = max(0.0, w.load_seconds - est)
             w.mem_load_mb = max(0.0, w.mem_load_mb - mem)
             w.tasks_queue = [t for t in w.tasks_queue if t.get("subtask_id") != stid]
@@ -398,15 +594,85 @@ class PlacementEngine:
             self._monitor_thread = None
 
     def sweep(self) -> List[str]:
-        """One failure-detection pass; returns ids of workers declared dead."""
+        """One failure-detection pass: dead-worker detection (heartbeat
+        silence), lease reclaim from LIVE but hung workers, and the
+        speculative-execution check. Returns ids of workers declared
+        dead."""
         now = time.time()
         dead: List[WorkerState] = []
+        reclaimed: List[tuple] = []  # (worker_id, task)
         with self._lock:
             for wid, w in list(self.workers.items()):
                 if now - w.last_heartbeat > self.cfg.dead_after_s:
                     dead.append(self.workers.pop(wid))
+                    continue
+                # lease reclaim: an expired lease on a live worker means the
+                # worker is hung (or silently dropped the result) — pull the
+                # task back and release the books; re-dispatch happens below
+                for task in list(w.tasks_queue):
+                    stid = task.get("subtask_id")
+                    deadline = w.task_lease.get(stid)
+                    if deadline is None or now <= deadline:
+                        continue
+                    w.tasks_queue = [
+                        t for t in w.tasks_queue
+                        if t.get("subtask_id") != stid
+                    ]
+                    est = w.task_est.pop(stid, 0.0)
+                    mem = w.task_mem.pop(stid, 0.0)
+                    w.task_lease.pop(stid, None)
+                    w.task_placed_at.pop(stid, None)
+                    w.load_seconds = max(0.0, w.load_seconds - est)
+                    w.mem_load_mb = max(0.0, w.mem_load_mb - mem)
+                    reclaimed.append((wid, task))
             if dead:
                 gauge_set("tpuml_workers_alive", len(self.workers))
+        for wid, task in reclaimed:
+            stid = task.get("subtask_id")
+            if stid and self.ledger.is_done(stid):
+                continue  # a duplicate attempt already delivered a result
+            # a reclaim is a failed execution budget-wise: a subtask that
+            # hangs EVERY worker must exhaust its budget and quarantine,
+            # not cycle through reclaims until the job's hard deadline.
+            # When this reclaim would be the final allowed execution, a
+            # synthetic failed result goes to the coordinator (whose
+            # ingest counts it and quarantines) instead of a re-dispatch.
+            entry = self.ledger.get(stid)
+            failures_so_far = entry.failures if entry is not None else 0
+            if failures_so_far + 1 >= self.cfg.retry_max_attempts:
+                logger.error(
+                    "Lease expired for %s on %s and its retry budget is "
+                    "exhausted (%d prior failures); failing it for "
+                    "quarantine", stid, wid, failures_so_far,
+                )
+                if self.bus is not None:
+                    self.bus.publish(TOPIC_RESULT, {
+                        "subtask_id": stid,
+                        "job_id": task.get("job_id"),
+                        "model_type": task.get("model_type"),
+                        "parameters": task.get("parameters"),
+                        "status": "failed",
+                        "error": f"lease expired on worker {wid} "
+                                 f"(hung or silent) with no budget left",
+                        "error_kind": "lease_expired",
+                        "attempt": int(task.get("attempt") or 0),
+                        "worker_id": wid,
+                    }, key=stid)
+                continue
+            self.ledger.record_failure(stid, wid)
+            # COPY before stamping: the hung executor still holds this
+            # dict (the bus delivers by reference) — mutating it in place
+            # would let the zombie's eventual result carry the NEW attempt
+            # id and defeat the attempt-stamp dedup
+            task = dict(task)
+            logger.warning(
+                "Lease expired for %s on live worker %s; reclaiming and "
+                "requeueing (attempt %d)",
+                stid, wid, int(task.get("attempt") or 0) + 1,
+            )
+            self.ledger.next_attempt(task, exclude_worker=wid, reason="lease")
+            counter_inc("tpuml_subtasks_retried_total", reason="lease")
+            self._replace(task)
         for w in dead:
             logger.warning(
                 "Worker %s dead (no heartbeat for >%ss); requeueing %d tasks",
@@ -415,10 +681,90 @@ class PlacementEngine:
                 len(w.tasks_queue),
             )
             self._drop_worker_gauges(w.worker_id)
-            self._requeue(w.tasks_queue)
-        if dead:
+            self._requeue(w.tasks_queue, from_worker=w.worker_id)
+        self._speculate()
+        if dead or reclaimed:
             self.refresh_health_metrics()
         return [w.worker_id for w in dead]
+
+    def _speculate(self) -> List[Dict[str, Any]]:
+        """Backup-task launch (Dean & Ghemawat OSDI'04; "The Tail at
+        Scale"): an in-flight subtask whose age exceeds
+        ``straggler_factor`` x the peer-median batch EWMA (floored at
+        ``speculative_min_inflight_s``) gets ONE duplicate on an idle,
+        breaker-closed worker, excluded from its owner. At most one launch
+        per straggling worker per sweep; the coordinator's result ingest
+        dedups by attempt id — first terminal result wins."""
+        cfg = self.cfg
+        if not cfg.speculative_enabled:
+            return []
+        now = time.time()
+        launches: List[tuple] = []  # (owner_wid, task copy)
+        with self._lock:
+            measured = [
+                (wid, w.ewma_batch_s)
+                for wid, w in self.workers.items()
+                if w.ewma_batch_s is not None
+                and w.n_batches >= cfg.straggler_min_batches
+            ]
+            if len(measured) < 2:
+                return []
+            idle = sum(
+                1 for w in self.workers.values()
+                if not w.tasks_queue and w.breaker_state == "closed"
+            )
+            if idle == 0:
+                return []
+            for wid, w in self.workers.items():
+                if len(launches) >= idle:
+                    break
+                if not w.tasks_queue:
+                    continue
+                others = sorted(v for o, v in measured if o != wid)
+                if not others:
+                    continue
+                mid = len(others) // 2
+                median = (
+                    others[mid]
+                    if len(others) % 2
+                    else 0.5 * (others[mid - 1] + others[mid])
+                )
+                threshold = max(
+                    cfg.speculative_min_inflight_s,
+                    cfg.straggler_factor * median,
+                )
+                for task in w.tasks_queue:
+                    stid = task.get("subtask_id")
+                    if not stid:
+                        continue
+                    placed = w.task_placed_at.get(stid)
+                    if placed is None or now - placed <= threshold:
+                        continue
+                    if self.ledger.was_speculated(stid) or self.ledger.is_done(stid):
+                        continue
+                    launches.append((wid, dict(task), now - placed))
+                    break  # one duplicate per straggling worker per sweep
+        launched = []
+        for owner, task, age in launches:
+            self.ledger.next_attempt(
+                task, exclude_worker=owner, reason="speculative",
+                speculative=True,
+            )
+            counter_inc("tpuml_speculative_launched_total")
+            logger.warning(
+                "Speculating duplicate of %s (in-flight %.1fs on %s, "
+                "attempt %d)",
+                task.get("subtask_id"), age, owner, task["attempt"],
+            )
+            tid = task.get("trace_id")
+            if tid:
+                with span("schedule.speculate", trace_id=tid, parent_id=None,
+                          subtask_id=task.get("subtask_id"), owner=owner,
+                          attempt=task["attempt"]):
+                    pass
+            self._replace(task)
+            launched.append(task)
+        return launched
 
     def _monitor_loop(self) -> None:
         while not self._stop.wait(self.cfg.sweep_interval_s):
@@ -427,18 +773,41 @@ class PlacementEngine:
             except Exception:  # noqa: BLE001
                 logger.exception("Heartbeat sweep failed")
 
-    def _requeue(self, tasks: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    def _requeue(
+        self, tasks: List[Dict[str, Any]], from_worker: Optional[str] = None
+    ) -> List[Dict[str, Any]]:
+        """Re-place tasks off a dead/unsubscribed/evicted worker. Each gets
+        a fresh attempt id (attempt-stamp dedup stays sound even if a
+        'dead' worker turns out to be a zombie and reports late) with the
+        departed worker remembered as excluded; tasks whose ledger entry is
+        already terminal are dropped, not re-run."""
         requeued = []
         for task in tasks:
-            counter_inc("tpuml_subtasks_requeued_total")
-            wid = self.place(task)
-            if wid is None:
-                logger.error(
-                    "No surviving worker for %s; task dropped back to tasks topic",
-                    task.get("subtask_id"),
+            stid = task.get("subtask_id")
+            if stid and self.ledger.is_done(stid):
+                continue  # a duplicate attempt already delivered a result
+            if stid:
+                # copy before stamping: a zombie worker (swept as dead but
+                # actually wedged) still holds this dict — in-place attempt
+                # mutation would defeat the attempt-stamp dedup
+                task = dict(task)
+                self.ledger.next_attempt(
+                    task, exclude_worker=from_worker, reason="requeue"
                 )
-                if self.bus is not None:
-                    self.bus.publish(TOPIC_TASKS, task)
-            else:
+            counter_inc("tpuml_subtasks_requeued_total")
+            if self._replace(task) is not None:
                 requeued.append(task)
         return requeued
+
+    def _replace(self, task: Dict[str, Any]) -> Optional[str]:
+        """Place a reclaimed/requeued/speculative task, or drop it back to
+        the tasks topic when no worker survives."""
+        wid = self.place(task)
+        if wid is None:
+            logger.error(
+                "No surviving worker for %s; task dropped back to tasks topic",
+                task.get("subtask_id"),
+            )
+            if self.bus is not None:
+                self.bus.publish(TOPIC_TASKS, task)
+        return wid

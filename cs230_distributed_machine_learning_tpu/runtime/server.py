@@ -151,7 +151,7 @@ async function tick(){
   document.getElementById("jobs").tBodies[0].innerHTML =
     (Array.isArray(jobs) ? jobs : []).map(j => `<tr>
     <td>${esc(j.job_id)}</td><td>${esc(j.model_type)}</td><td>${esc(j.dataset_id)}</td>
-    <td class="${j.status === "completed" ? "ok" : j.status === "failed" ? "bad" : ""}">${esc(j.status)}</td>
+    <td class="${j.status === "completed" ? "ok" : (j.status === "failed" || j.status === "completed_with_failures") ? "bad" : ""}">${esc(j.status)}</td>
     <td>${esc(j.completed_subtasks)}</td><td>${esc(j.failed_subtasks)}</td>
     <td>${esc(j.total_subtasks)}</td><td>${esc((j.session_id || "").slice(0, 8))}</td></tr>`).join("")
     || "<tr><td colspan=8>no jobs yet</td></tr>";

@@ -25,6 +25,23 @@ from typing import Any, Dict, List, Optional
 
 from ..utils.serialization import json_safe
 
+#: job statuses past which no further transitions happen.
+#: ``completed_with_failures`` is the quarantine contract
+#: (docs/ROBUSTNESS.md): the job finished with partial results plus a
+#: structured ``failed_subtasks`` report instead of stalling on a
+#: poisoned subtask.
+TERMINAL_STATUSES = ("completed", "failed", "completed_with_failures")
+
+
+def _final_status(result) -> str:
+    """Derive the terminal job status from a finalize payload."""
+    result = result or {}
+    if result.get("status") == "failed":
+        return "failed"
+    if result.get("failed_subtasks"):
+        return "completed_with_failures"
+    return "completed"
+
 
 class JobStore:
     def __init__(self, journal_dir: Optional[str] = None):
@@ -149,8 +166,39 @@ class JobStore:
             }
         )
 
+    def record_attempt(
+        self,
+        sid: str,
+        job_id: str,
+        subtask_id: str,
+        attempt: int,
+        failures: int = 0,
+        excluded: Optional[List[str]] = None,
+    ) -> None:
+        """Journal a subtask attempt issue (lease reclaim, failure retry,
+        requeue, speculation) into the spec, so a replayed coordinator
+        resumes with retry budgets and excluded-worker memory intact
+        instead of resetting every subtask to a fresh budget."""
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            spec = job["subtasks"][subtask_id]["spec"]
+            spec["attempt"] = int(attempt)
+            spec["failures"] = int(failures)
+            spec["excluded_workers"] = list(excluded or [])
+        self._journal(
+            {
+                "op": "subtask_attempt",
+                "sid": sid,
+                "jid": job_id,
+                "stid": subtask_id,
+                "attempt": int(attempt),
+                "failures": int(failures),
+                "excluded": list(excluded or []),
+            }
+        )
+
     def finalize_job(self, sid: str, job_id: str, result: Dict[str, Any]) -> None:
-        status = "failed" if result.get("status") == "failed" else "completed"
+        status = _final_status(result)
         with self._lock:
             job = self._require_job(sid, job_id)
             job["result"] = json_safe(result)
@@ -180,7 +228,7 @@ class JobStore:
         poll loop (core.py:180-199); returns False on timeout."""
         with self._lock:
             job = self._require_job(sid, job_id)
-            if job["status"] in ("completed", "failed"):
+            if job["status"] in TERMINAL_STATUSES:
                 return True
             event = self._done_events.setdefault((sid, job_id), threading.Event())
         return event.wait(timeout)
@@ -197,9 +245,14 @@ class JobStore:
                 "job_status": job["status"],
                 "tasks_completed": done,
                 "tasks_pending": job["total_subtasks"] - done,
+                # degradation surfaced mid-stream AND in the final event:
+                # increments the moment a subtask is QUARANTINED (retries
+                # in flight are not terminal and do not count), final
+                # under completed_with_failures (docs/ROBUSTNESS.md)
+                "tasks_failed": job["failed_subtasks"],
                 "total_subtasks": job["total_subtasks"],
                 "job_result": job["result"]
-                if job["status"] in ("completed", "failed")
+                if job["status"] in TERMINAL_STATUSES
                 else None,
             }
 
@@ -211,7 +264,7 @@ class JobStore:
                 (sid, jid)
                 for sid, sess in self._sessions.items()
                 for jid, job in sess["jobs"].items()
-                if job["status"] not in ("completed", "failed")
+                if job["status"] not in TERMINAL_STATUSES
             ]
 
     def subtask_results(self, sid: str, job_id: str) -> List[Dict[str, Any]]:
@@ -284,15 +337,26 @@ class JobStore:
                             job[key] += 1
                     except KeyError:
                         continue
+                elif op == "subtask_attempt":
+                    # fault-tolerance bookkeeping (docs/ROBUSTNESS.md):
+                    # restore retry budgets / excluded-worker memory into
+                    # the spec. Journals that predate the attempt schema
+                    # simply have no such ops — every reader of the fields
+                    # defaults to a zeroed budget (.get(..., 0)), the same
+                    # fallback style as completion_time below.
+                    try:
+                        job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                        spec = job["subtasks"][e["stid"]]["spec"]
+                        spec["attempt"] = int(e.get("attempt", 0) or 0)
+                        spec["failures"] = int(e.get("failures", 0) or 0)
+                        spec["excluded_workers"] = list(e.get("excluded") or [])
+                    except KeyError:
+                        continue
                 elif op == "finalize_job":
                     try:
                         job = self._sessions[e["sid"]]["jobs"][e["jid"]]
                         job["result"] = e["result"]
-                        job["status"] = (
-                            "failed"
-                            if (e["result"] or {}).get("status") == "failed"
-                            else "completed"
-                        )
+                        job["status"] = _final_status(e["result"])
                         # older journals predate the field: fall back to
                         # the entry's absence rather than losing the job
                         if e.get("completion_time") is not None:

@@ -57,6 +57,11 @@ def create_subtasks(
                 "parameters": params,
                 "search_params": combo,
                 "train_params": {**train_params, **cv_params},
+                # fault-tolerance bookkeeping (docs/ROBUSTNESS.md): the
+                # attempt id stamps every dispatched copy; reclaims and
+                # retries bump it through the AttemptLedger. Journals from
+                # before this field replay fine — readers default to 0.
+                "attempt": 0,
             }
         )
     return subtasks

@@ -73,6 +73,36 @@ class SchedulerConfig:
     # advisory placement-score penalty (seconds) added to flagged
     # stragglers — eligibility and fallback semantics are untouched
     straggler_penalty_s: float = 30.0
+    # ---- fault-tolerance layer (docs/ROBUSTNESS.md) ----
+    # every placed subtask carries a lease: deadline = now +
+    # max(lease_floor_s, lease_factor x predicted completion time on the
+    # chosen worker, queue wait included). The sweep reclaims and requeues
+    # expired leases from LIVE but hung workers. factor <= 0 disables.
+    lease_factor: float = 4.0
+    lease_floor_s: float = 30.0
+    # total execution attempts per subtask before quarantine (failed or
+    # lease-reclaimed executions both consume the budget)
+    retry_max_attempts: int = 3
+    # per-attempt exponential backoff before a failure retry:
+    # retry_backoff_s x 2^(failures-1), capped at retry_backoff_max_s
+    retry_backoff_s: float = 0.5
+    retry_backoff_max_s: float = 10.0
+    # a subtask that killed this many worker backends (DeviceLostError
+    # correlation) is poisoned and quarantined immediately
+    poison_kill_threshold: int = 2
+    # speculative execution (MapReduce backup tasks): when a subtask's
+    # in-flight time exceeds straggler_factor x the peer-median batch EWMA
+    # (floored at speculative_min_inflight_s) and an idle worker exists,
+    # launch ONE duplicate there; first terminal result wins
+    speculative_enabled: bool = True
+    speculative_min_inflight_s: float = 10.0
+    # worker circuit breaker: trip to half-open (probe tasks only) when
+    # failed/total outcomes since the last transition reaches the ratio
+    # over at least min_outcomes; evict after max_trips trips. ratio <= 0
+    # disables.
+    breaker_failure_ratio: float = 0.5
+    breaker_min_outcomes: int = 4
+    breaker_max_trips: int = 3
 
 
 @dataclasses.dataclass

@@ -43,6 +43,13 @@ def fast_cfg():
     # this timeout, so the kill still lands mid-job.
     cfg.scheduler.dead_after_s = 3.0
     cfg.scheduler.sweep_interval_s = 0.3
+    # this fixture serves the DEAD-worker recovery proof: park the lease
+    # and speculation layers far out of the way so a cold 250-trial batch
+    # on the loaded 1-core box can't trip spurious reclaims mid-run (the
+    # lease/speculation paths have their own tests: test_fault_tolerance
+    # and test_chaos_hung_worker_lease_reclaim_completes_on_survivors)
+    cfg.scheduler.lease_floor_s = 1800.0
+    cfg.scheduler.speculative_enabled = False
     return cfg
 
 
@@ -90,6 +97,68 @@ def _run_fleet(chaos: bool):
     except Exception:
         cluster.shutdown()
         raise
+
+
+@pytest.mark.slow  # fleet-scale hung-worker recovery: ~a minute of wall
+def test_chaos_hung_worker_lease_reclaim_completes_on_survivors():
+    """A worker that hangs mid-batch — heartbeats alive, batch delayed far
+    past its lease (FaultInjector delay >> lease) — must NOT hold its
+    subtasks forever: the lease sweep reclaims them onto the survivors and
+    the job completes with correct, non-duplicated results
+    (docs/ROBUSTNESS.md; ISSUE 4 acceptance scenario at fleet scale)."""
+    cfg = get_config()
+    cfg.scheduler.heartbeat_interval_s = 0.05
+    cfg.scheduler.dead_after_s = 120.0  # the hung worker stays "alive"
+    cfg.scheduler.sweep_interval_s = 0.3
+    cfg.scheduler.lease_factor = 1.0
+    # the floor must exceed a SURVIVOR's cold-batch wall on the loaded
+    # 1-core box (reclaims consume retry budget — churning leases on
+    # healthy workers would quarantine innocent trials); the hung
+    # worker's 300 s delay still dwarfs it
+    cfg.scheduler.lease_floor_s = 60.0
+    cfg.scheduler.retry_max_attempts = 5
+    cfg.scheduler.speculative_enabled = False
+
+    n_trials = 100
+    cluster = ClusterRuntime()
+    try:
+        hung = LocalExecutor(
+            executor_id="tmp",
+            max_trials_per_batch=32,
+            fault_injector=FaultInjector(delay_s=300.0),
+        )
+        hung_wid = cluster.add_executor(executor=hung)
+        for _ in range(2):
+            cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        submit = m.train(
+            RandomizedSearchCV(
+                LogisticRegression(max_iter=200),
+                {"C": loguniform(1e-3, 1e2), "fit_intercept": [True, False]},
+                n_iter=n_trials,
+                cv=3,
+                random_state=11,
+            ),
+            DATASET,
+            {"random_state": 0},
+            wait_for_completion=False,
+            show_progress=False,
+        )
+        status = coord.wait_for_completion(
+            m.session_id, submit["job_id"], timeout_s=600
+        )
+        assert status["job_status"] == "completed"
+        results = status["job_result"]["results"]
+        assert len(results) == n_trials
+        ids = [r["subtask_id"] for r in results]
+        assert len(set(ids)) == n_trials, "duplicated trials in results"
+        assert all(r["status"] == "completed" for r in results)
+        assert status["job_result"]["failed"] == []
+        # the hung worker was never declared dead: still registered, alive
+        assert hung_wid in cluster.engine.worker_snapshot()
+    finally:
+        cluster.shutdown()
 
 
 @pytest.mark.slow  # 1000-trial 4-agent kill-mid-job fleet: minutes of wall

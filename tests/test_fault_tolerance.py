@@ -1,0 +1,627 @@
+"""Fault-tolerance layer (docs/ROBUSTNESS.md): leases, retry budgets,
+poison quarantine, speculative execution, and the worker circuit breaker.
+
+Engine- and ledger-level unit tests plus fast end-to-end cluster runs:
+a hung worker (lease reclaim), a silent worker (dropped results), an
+always-failing worker (retry on survivor), a poisoned subtask
+(quarantine -> ``completed_with_failures``), and a straggler-injected run
+(speculative win, no duplicate result rows).
+"""
+
+import json
+import time
+
+import pytest
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.executor import (
+    FaultInjector,
+    LocalExecutor,
+)
+from cs230_distributed_machine_learning_tpu.runtime.faults import AttemptLedger
+from cs230_distributed_machine_learning_tpu.runtime.scheduler import PlacementEngine
+from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+
+class FixedPredictor:
+    """Deterministic predictor stub for engine-level tests."""
+
+    def __init__(self, est=10.0):
+        self.est = est
+        self.observed = []
+        self.algo_weights = {}
+
+    def predict(self, task):
+        return self.est
+
+    def observe(self, task, actual):
+        self.observed.append((task.get("subtask_id"), actual))
+
+
+def _task(stid, mem=1.0, **extra):
+    return {"subtask_id": stid, "model_type": "LogisticRegression",
+            "mem_estimate_mb": mem, **extra}
+
+
+def _counter(name, **labels):
+    return REGISTRY.counter(name).value(**labels)
+
+
+def _complete(eng, wid, stid, wall=0.1):
+    now = time.time()
+    eng.on_metrics({"worker_id": wid, "subtask_id": stid,
+                    "started_at": now - wall, "finished_at": now})
+
+
+# ---------------- AttemptLedger ----------------
+
+
+def test_ledger_attempts_monotonic_and_excluded_accumulate():
+    led = AttemptLedger()
+    task = _task("t0")
+    e = led.next_attempt(task, exclude_worker="w0", reason="failure")
+    assert task["attempt"] == e.attempt == 1
+    assert task["excluded_workers"] == ["w0"]
+    e = led.next_attempt(task, exclude_worker="w1", reason="failure")
+    assert task["attempt"] == 2 and set(task["excluded_workers"]) == {"w0", "w1"}
+    # a task stamped with a HIGHER attempt than the ledger knows (e.g. a
+    # replayed spec) never issues a lower id
+    stale_led = AttemptLedger()
+    t2 = _task("t1", attempt=5)
+    assert stale_led.next_attempt(t2).attempt == 6
+
+
+def test_ledger_stale_done_and_device_loss():
+    led = AttemptLedger()
+    task = _task("t0")
+    led.next_attempt(task)  # attempt 1
+    assert led.is_stale("t0", 0) and not led.is_stale("t0", 1)
+    assert led.note_device_loss("t0") == 1
+    assert led.note_device_loss("t0") == 2
+    assert not led.is_done("t0")
+    led.mark_done("t0")
+    assert led.is_done("t0")
+    led.forget(["t0"])
+    assert led.get("t0") is None
+
+
+def test_ledger_seed_defaults_for_pre_attempt_specs():
+    """Specs from journals that predate the attempt schema carry none of
+    the fields — seeding must default to a zeroed budget, not crash."""
+    led = AttemptLedger()
+    old_spec = {"subtask_id": "j-subtask-0", "parameters": {}}  # no attempt
+    e = led.seed(old_spec)
+    assert e.attempt == 0 and e.failures == 0 and e.excluded == []
+    # and a spec WITH journaled budget restores it
+    e2 = led.seed({"subtask_id": "j-subtask-1", "attempt": 2, "failures": 1,
+                   "excluded_workers": ["w0"]})
+    assert e2.attempt == 2 and e2.failures == 1 and e2.excluded == ["w0"]
+
+
+def test_ledger_journal_hook_fires_with_snapshot():
+    seen = []
+    led = AttemptLedger(on_attempt=lambda t, e, r: seen.append((t["subtask_id"], e.attempt, r)))
+    led.next_attempt(_task("t0"), reason="lease")
+    assert seen == [("t0", 1, "lease")]
+
+
+# ---------------- leases (engine level) ----------------
+
+
+def test_lease_reclaims_task_from_live_hung_worker():
+    cfg = get_config().scheduler
+    cfg.lease_factor = 1.0
+    cfg.lease_floor_s = 0.2
+    cfg.speculative_enabled = False
+    eng = PlacementEngine(predictor=FixedPredictor(est=0.01))
+    eng.subscribe()
+    eng.subscribe()
+    before = _counter("tpuml_subtasks_retried_total", reason="lease")
+    owner = eng.place(_task("t0"))
+    other = "worker-1" if owner == "worker-0" else "worker-0"
+    time.sleep(0.25)
+    eng.heartbeat(owner)  # the hung worker is LIVE — only its lease expired
+    eng.heartbeat(other)
+    assert eng.sweep() == []  # nobody declared dead
+    q = eng.queue_snapshot()
+    assert q[other] == ["t0"] and q[owner] == []
+    moved = eng.workers[other].tasks_queue[0]
+    assert moved["attempt"] == 1
+    assert owner in moved["excluded_workers"]
+    assert _counter("tpuml_subtasks_retried_total", reason="lease") == before + 1
+    # the hung worker's books were released
+    snap = eng.worker_snapshot()
+    assert snap[owner]["load_seconds"] == 0.0
+
+
+def test_lease_reclaim_copies_task_before_stamping():
+    """The hung executor still holds the ORIGINAL task dict (the bus
+    delivers by reference): the reclaim must stamp a COPY, or the zombie's
+    eventual result would carry the new attempt id and defeat the
+    attempt-stamp dedup."""
+    cfg = get_config().scheduler
+    cfg.lease_factor = 1.0
+    cfg.lease_floor_s = 0.1
+    cfg.speculative_enabled = False
+    eng = PlacementEngine(predictor=FixedPredictor(est=0.01))
+    eng.subscribe()
+    eng.subscribe()
+    original = _task("t0")
+    owner = eng.place(original)
+    other = "worker-1" if owner == "worker-0" else "worker-0"
+    time.sleep(0.15)
+    eng.heartbeat(owner)
+    eng.heartbeat(other)
+    eng.sweep()
+    moved = eng.workers[other].tasks_queue[0]
+    assert moved["attempt"] == 1
+    assert moved is not original
+    assert original.get("attempt", 0) == 0  # the zombie's copy is untouched
+
+
+def test_lease_budget_exhaustion_fails_subtask_for_quarantine():
+    """A subtask that hangs EVERY worker must exhaust its budget: the
+    final reclaim publishes a synthetic lease_expired failed result (the
+    coordinator's ingest quarantines it) instead of reclaiming forever."""
+    from cs230_distributed_machine_learning_tpu.runtime.queue import TopicBus
+
+    cfg = get_config().scheduler
+    cfg.lease_factor = 1.0
+    cfg.lease_floor_s = 0.1
+    cfg.retry_max_attempts = 2
+    cfg.speculative_enabled = False
+    bus = TopicBus()
+    eng = PlacementEngine(bus=bus, predictor=FixedPredictor(est=0.01))
+    eng.subscribe()
+    eng.subscribe()
+    result_sub = bus.subscribe("result")
+    eng.place(_task("t0"))
+    for _ in range(2):  # reclaim 1 (re-dispatch), reclaim 2 (give up)
+        time.sleep(0.15)
+        eng.heartbeat("worker-0")
+        eng.heartbeat("worker-1")
+        eng.sweep()
+    stid, result = result_sub.get_nowait()
+    assert stid == "t0"
+    assert result["status"] == "failed"
+    assert result["error_kind"] == "lease_expired"
+    # the task is out of every queue — no further reclaims possible
+    assert all(q == [] for q in eng.queue_snapshot().values())
+
+
+def test_lease_respects_queue_depth_and_release_task():
+    cfg = get_config().scheduler
+    cfg.lease_factor = 10.0
+    cfg.lease_floor_s = 0.05
+    eng = PlacementEngine(predictor=FixedPredictor(est=5.0))
+    eng.subscribe()
+    eng.place(_task("a"))
+    eng.place(_task("b"))
+    w = eng.workers["worker-0"]
+    # the second task's lease covers the queue wait (2 tasks x 5 s x 10)
+    assert w.task_lease["b"] - time.time() > 50.0
+    assert eng.release_task("worker-0", "b") is True
+    assert "b" not in w.task_est and len(w.tasks_queue) == 1
+    assert w.load_seconds == pytest.approx(5.0)
+    assert eng.release_task("worker-0", "b") is False  # already gone
+
+
+# ---------------- circuit breaker ----------------
+
+
+def test_breaker_trips_probes_and_recovers():
+    cfg = get_config().scheduler
+    cfg.breaker_min_outcomes = 4
+    cfg.breaker_failure_ratio = 0.5
+    cfg.breaker_max_trips = 2
+    cfg.speculative_enabled = False
+    eng = PlacementEngine(predictor=FixedPredictor(est=10.0))
+    eng.subscribe()
+    eng.subscribe()
+    for _ in range(4):
+        eng.record_outcome("worker-0", False)
+    snap = eng.health_snapshot()
+    assert snap["worker-0"]["breaker_state"] == "half_open"
+    assert snap["worker-0"]["breaker_trips"] == 1
+    # half-open gets PROBE tasks only: an idle half-open worker may take one
+    probe = _task("p0", excluded_workers=["worker-1"])
+    assert eng.place(probe) == "worker-0"
+    # ...but with one in flight it is skipped even at a better score
+    assert eng.place(_task("n1")) == "worker-1"
+    assert eng.place(_task("n2")) == "worker-1"
+    # probe succeeds -> closed again
+    _complete(eng, "worker-0", "p0")
+    eng.record_outcome("worker-0", True)
+    assert eng.health_snapshot()["worker-0"]["breaker_state"] == "closed"
+
+
+def test_breaker_evicts_after_max_trips_and_requeues():
+    cfg = get_config().scheduler
+    cfg.breaker_min_outcomes = 2
+    cfg.breaker_failure_ratio = 0.5
+    cfg.breaker_max_trips = 2
+    cfg.speculative_enabled = False
+    eng = PlacementEngine(predictor=FixedPredictor(est=1.0))
+    eng.subscribe()
+    eng.subscribe()
+    evicted = []
+    eng.on_evict = evicted.append
+    stuck = _task("s0", excluded_workers=["worker-1"])
+    assert eng.place(stuck) == "worker-0"
+    for _ in range(2):
+        eng.record_outcome("worker-0", False)  # trip 1 -> half_open
+    for _ in range(2):
+        eng.record_outcome("worker-0", False)  # probe fails x2 -> trip 2 -> evict
+    assert "worker-0" not in eng.worker_snapshot()
+    assert evicted == ["worker-0"]
+    # the queued task moved to the survivor with the evictee excluded
+    q = eng.queue_snapshot()
+    assert q["worker-1"] == ["s0"]
+    moved = eng.workers["worker-1"].tasks_queue[0]
+    assert "worker-0" in moved["excluded_workers"]
+
+
+def test_breaker_window_decays_so_long_history_cannot_mask_failures():
+    """The closed-state window is bounded (counters halve at 8x
+    min_outcomes): a worker with 1000 past successes must trip after a
+    short failure streak, not after 1000 more failures."""
+    cfg = get_config().scheduler
+    cfg.breaker_min_outcomes = 4
+    cfg.breaker_failure_ratio = 0.5
+    cfg.breaker_max_trips = 10
+    cfg.speculative_enabled = False
+    eng = PlacementEngine(predictor=FixedPredictor(est=1.0))
+    eng.subscribe()
+    for _ in range(1000):
+        eng.record_outcome("worker-0", True)
+    failures = 0
+    while (eng.health_snapshot()["worker-0"]["breaker_state"] == "closed"
+           and failures < 100):
+        eng.record_outcome("worker-0", False)
+        failures += 1
+    assert eng.health_snapshot()["worker-0"]["breaker_state"] == "half_open"
+    assert failures <= 32, f"took {failures} failures to trip"
+
+
+# ---------------- speculative execution (engine level) ----------------
+
+
+def test_speculation_launches_one_duplicate_on_idle_worker():
+    cfg = get_config().scheduler
+    cfg.speculative_enabled = True
+    cfg.speculative_min_inflight_s = 0.1
+    cfg.straggler_min_batches = 1
+    cfg.straggler_factor = 2.0
+    cfg.lease_floor_s = 30.0
+    eng = PlacementEngine(predictor=FixedPredictor(est=0.05))
+    eng.subscribe()
+    eng.subscribe()
+    # both workers have a batch EWMA (the peer-median input)
+    _complete(eng, "worker-0", "prime-0", wall=0.05)
+    _complete(eng, "worker-1", "prime-1", wall=0.05)
+    before = _counter("tpuml_speculative_launched_total")
+    assert eng.place(_task("t0", excluded_workers=["worker-1"])) == "worker-0"
+    time.sleep(0.15)  # > max(0.1, 2 x 0.05)
+    eng.heartbeat("worker-0")
+    eng.heartbeat("worker-1")
+    eng.sweep()
+    q = eng.queue_snapshot()
+    assert q["worker-0"] == ["t0"] and q["worker-1"] == ["t0"]  # duplicate
+    copy = eng.workers["worker-1"].tasks_queue[0]
+    assert copy.get("speculative") is True and copy["attempt"] == 1
+    assert _counter("tpuml_speculative_launched_total") == before + 1
+    assert eng.ledger.was_speculated("t0")
+    # at most ONE duplicate ever: a second sweep launches nothing
+    eng.sweep()
+    assert _counter("tpuml_speculative_launched_total") == before + 1
+
+
+# ---------------- FaultInjector satellites ----------------
+
+
+def test_fault_injector_drop_results_and_worker_targeting():
+    inj = FaultInjector(drop_results=1, only_worker="w-a")
+    assert inj.drop_batch_results("w-b") is False  # untargeted worker
+    assert inj.drop_batch_results("w-a") is True
+    assert inj.drop_batch_results("w-a") is False  # budget consumed
+    inj2 = FaultInjector(fail_batches=1, delay_s=0.0, only_worker="w-a")
+    inj2.before_batch("w-b", "m")  # no raise: other workers untouched
+    with pytest.raises(RuntimeError):
+        inj2.before_batch("w-a", "m")
+
+
+# ---------------- journal schema compatibility ----------------
+
+
+def test_record_attempt_journaled_and_replayed(tmp_path):
+    jd = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    subtasks = [{"subtask_id": "j-subtask-0", "attempt": 0}]
+    store.create_job(sid, "j", {}, subtasks)
+    store.record_attempt(sid, "j", "j-subtask-0", attempt=2, failures=1,
+                         excluded=["worker-0"])
+    resumed = JobStore(journal_dir=jd)
+    spec = resumed.get_job(sid, "j")["subtasks"]["j-subtask-0"]["spec"]
+    assert spec["attempt"] == 2 and spec["failures"] == 1
+    assert spec["excluded_workers"] == ["worker-0"]
+
+
+def test_pre_attempt_schema_journal_replays_with_zero_budget(tmp_path):
+    """A jobs.jsonl written before the attempt schema (no ``attempt`` in
+    specs, no subtask_attempt ops) must replay cleanly and default every
+    budget to zero — the 'older journals predate the field' contract."""
+    jd = tmp_path / "journal"
+    jd.mkdir()
+    old_record = {
+        "job_id": "j", "payload": {}, "created_at": 1.0,
+        "total_subtasks": 1, "completed_subtasks": 0, "failed_subtasks": 0,
+        "status": "pending",
+        "subtasks": {"j-subtask-0": {
+            "spec": {"subtask_id": "j-subtask-0", "job_id": "j",
+                     "model_type": "LogisticRegression", "parameters": {}},
+            "status": "pending", "result": None}},
+        "metadata": {}, "result": None,
+    }
+    lines = [
+        {"op": "create_session", "sid": "s"},
+        {"op": "create_job", "sid": "s", "record": old_record},
+        # an attempt op for an id the journal never created: skipped
+        {"op": "subtask_attempt", "sid": "s", "jid": "j", "stid": "ghost",
+         "attempt": 1},
+    ]
+    (jd / "jobs.jsonl").write_text(
+        "\n".join(json.dumps(e) for e in lines) + "\n"
+    )
+    store = JobStore(journal_dir=str(jd))
+    spec = store.get_job("s", "j")["subtasks"]["j-subtask-0"]["spec"]
+    assert "attempt" not in spec  # untouched by replay
+    assert AttemptLedger().seed(spec).attempt == 0  # readers default to 0
+
+
+def test_completed_with_failures_is_terminal_and_replays(tmp_path):
+    jd = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(sid, "j", {}, [{"subtask_id": "j-subtask-0"}])
+    store.finalize_job(sid, "j", {
+        "results": [], "best_result": None,
+        "failed_subtasks": [{"subtask_id": "j-subtask-0",
+                             "reason": "retries_exhausted"}],
+    })
+    assert store.job_progress(sid, "j")["job_status"] == "completed_with_failures"
+    assert store.wait_job(sid, "j", timeout=0.0) is True  # terminal
+    resumed = JobStore(journal_dir=jd)
+    assert resumed.job_progress(sid, "j")["job_status"] == "completed_with_failures"
+    assert resumed.unfinished_jobs() == []  # not resumed as in-flight
+
+
+# ---------------- end-to-end cluster scenarios ----------------
+
+
+@pytest.fixture()
+def ft_cfg():
+    cfg = get_config()
+    cfg.scheduler.heartbeat_interval_s = 0.05
+    cfg.scheduler.dead_after_s = 30.0  # hung workers stay "alive"
+    cfg.scheduler.sweep_interval_s = 0.1
+    cfg.scheduler.lease_factor = 0.5
+    # floor above a cold batch's compile on the loaded test box: the
+    # HEALTHY worker's first batch must finish inside its own lease, or
+    # reclaim churn burns the retry budget on innocent workers
+    cfg.scheduler.lease_floor_s = 4.0
+    cfg.scheduler.retry_max_attempts = 5
+    cfg.scheduler.retry_backoff_s = 0.05
+    cfg.scheduler.retry_backoff_max_s = 0.2
+    cfg.scheduler.speculative_enabled = False
+    return cfg
+
+
+def _job(n=4):
+    return GridSearchCV(
+        LogisticRegression(max_iter=300),
+        {"C": [0.01, 0.1, 1.0, 10.0][:n]},
+        cv=3,
+    )
+
+
+def _assert_clean_results(status, n):
+    results = status["job_result"]["results"]
+    assert len(results) == n
+    ids = [r["subtask_id"] for r in results]
+    assert len(set(ids)) == n, "duplicate result rows"
+    assert all(r["status"] == "completed" for r in results)
+
+
+def test_hung_worker_lease_reclaim_job_completes_on_survivor(ft_cfg):
+    """A worker that hangs mid-batch (delay far past the lease) keeps
+    heartbeating — the old dead-worker sweep never fires. The lease layer
+    reclaims its subtasks onto the survivor and the job completes."""
+    cluster = ClusterRuntime()
+    try:
+        hung = LocalExecutor(
+            executor_id="tmp",
+            fault_injector=FaultInjector(delay_s=15.0),
+        )
+        before = _counter("tpuml_subtasks_retried_total", reason="lease")
+        hung_wid = cluster.add_executor(executor=hung)
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        submit = m.train(_job(), "iris", wait_for_completion=False,
+                         show_progress=False)
+        time.sleep(0.3)  # every subtask lands on (and is pulled by) the hung worker
+        cluster.add_executor()
+        status = coord.wait_for_completion(m.session_id, submit["job_id"],
+                                           timeout_s=60)
+        assert status["job_status"] == "completed"
+        _assert_clean_results(status, 4)
+        # the hung worker was never declared dead — it is still registered
+        assert hung_wid in cluster.engine.worker_snapshot()
+        assert _counter("tpuml_subtasks_retried_total", reason="lease") > before
+    finally:
+        cluster.shutdown()
+
+
+def test_silent_worker_dropped_results_recovered_by_lease(ft_cfg):
+    """drop_results chaos: the worker RUNS its batches but never reports
+    (result and metrics messages dropped). Its books never clear, leases
+    expire, and the job completes on the survivor."""
+    cluster = ClusterRuntime()
+    try:
+        silent = LocalExecutor(
+            executor_id="tmp",
+            fault_injector=FaultInjector(drop_results=10),
+        )
+        cluster.add_executor(executor=silent)
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        submit = m.train(_job(2), "iris", wait_for_completion=False,
+                         show_progress=False)
+        time.sleep(0.3)
+        cluster.add_executor()
+        status = coord.wait_for_completion(m.session_id, submit["job_id"],
+                                           timeout_s=60)
+        assert status["job_status"] == "completed"
+        _assert_clean_results(status, 2)
+    finally:
+        cluster.shutdown()
+
+
+def test_failing_worker_retries_complete_on_survivor(ft_cfg):
+    """Transient/worker-local failures are no longer terminal: the failed
+    attempts are retried with the failing worker excluded, and the job
+    completes fully."""
+    ft_cfg.scheduler.breaker_failure_ratio = 0.0  # isolate the retry path
+    cluster = ClusterRuntime()
+    try:
+        bad = LocalExecutor(
+            executor_id="tmp",
+            fault_injector=FaultInjector(fail_batches=10 ** 6),
+        )
+        before = _counter("tpuml_subtasks_retried_total", reason="failure")
+        cluster.add_executor(executor=bad)
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(_job(), "iris", show_progress=False)
+        assert status["job_status"] == "completed"
+        _assert_clean_results(status, 4)
+        assert status["job_result"]["failed"] == []
+        assert _counter("tpuml_subtasks_retried_total", reason="failure") > before
+    finally:
+        cluster.shutdown()
+
+
+def test_always_failing_subtask_quarantined_with_partial_status(ft_cfg):
+    """A subtask that fails on EVERY worker exhausts its retry budget and
+    is quarantined: the job finalizes as ``completed_with_failures`` with
+    a structured failed_subtasks report instead of stalling or flapping
+    forever."""
+    ft_cfg.scheduler.retry_max_attempts = 2
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        sid = coord.create_session()
+        before = _counter("tpuml_subtasks_quarantined_total")
+        submit = coord.submit_train(sid, {
+            "dataset_id": "no_such_dataset",  # every attempt fails
+            "model_details": {"model_type": "LogisticRegression",
+                              "base_estimator_params": {"max_iter": 100}},
+            "train_params": {},
+        })
+        coord.wait_for_completion(sid, submit["job_id"], timeout_s=60)
+        status = coord.check_status(sid, submit["job_id"])
+        assert status["job_status"] == "completed_with_failures"
+        report = status["job_result"]["failed_subtasks"]
+        assert len(report) == 1
+        assert report[0]["attempts"] == 2
+        assert report[0]["reason"] == "retries_exhausted"
+        assert "no_such_dataset" in (report[0]["error"] or "")
+        assert _counter("tpuml_subtasks_quarantined_total") == before + 1
+        # degradation rides the progress/SSE schema too
+        progress = coord.store.job_progress(sid, submit["job_id"])
+        assert progress["tasks_failed"] == 1
+    finally:
+        cluster.shutdown()
+
+
+def test_subtask_that_kills_two_workers_is_poisoned(ft_cfg):
+    """DeviceLostError correlation: a subtask on its second killed worker
+    backend is quarantined as poisoned instead of being requeued to kill a
+    third — and the job still terminates (completed_with_failures)."""
+    ft_cfg.scheduler.dead_after_s = 0.5
+    ft_cfg.scheduler.sweep_interval_s = 0.1
+    ft_cfg.scheduler.poison_kill_threshold = 2
+    cluster = ClusterRuntime()
+    try:
+        for _ in range(2):
+            cluster.add_executor(executor=LocalExecutor(
+                executor_id="tmp",
+                fault_injector=FaultInjector(device_lost=True),
+            ))
+        coord = Coordinator(cluster=cluster)
+        sid = coord.create_session()
+        submit = coord.submit_train(sid, {
+            "dataset_id": "iris",
+            "model_details": {"model_type": "LogisticRegression",
+                              "base_estimator_params": {"max_iter": 100}},
+            "train_params": {},
+        })
+        coord.wait_for_completion(sid, submit["job_id"], timeout_s=60)
+        status = coord.check_status(sid, submit["job_id"])
+        assert status["job_status"] == "completed_with_failures"
+        report = status["job_result"]["failed_subtasks"]
+        assert len(report) == 1 and report[0]["reason"] == "poisoned"
+        # both poisoned backends leave the pool (second one via the sweep)
+        deadline = time.time() + 10
+        while cluster.engine.worker_snapshot() and time.time() < deadline:
+            time.sleep(0.1)
+        assert cluster.engine.worker_snapshot() == {}
+    finally:
+        cluster.shutdown()
+
+
+def test_straggler_speculation_wins_no_duplicate_rows(ft_cfg):
+    """Straggler-injected run: the slow worker's subtasks get speculative
+    duplicates on the idle peer; the duplicates' results win, the job
+    completes with no duplicate result rows, and the losers are ignored."""
+    cfg = ft_cfg.scheduler
+    cfg.speculative_enabled = True
+    cfg.speculative_min_inflight_s = 0.3
+    cfg.straggler_min_batches = 1
+    cfg.straggler_factor = 2.0
+    cfg.lease_factor = 0.0  # leases off: isolate the speculation path
+    cluster = ClusterRuntime()
+    try:
+        slow = LocalExecutor(
+            executor_id="tmp",
+            fault_injector=FaultInjector(delay_s=12.0),
+        )
+        slow_wid = cluster.add_executor(executor=slow)
+        fast_wid = cluster.add_executor()
+        # both workers need a batch EWMA for the peer-median rule
+        for wid in (slow_wid, fast_wid):
+            now = time.time()
+            cluster.engine.on_metrics({
+                "worker_id": wid, "subtask_id": f"prime-{wid}",
+                "started_at": now - 0.1, "finished_at": now,
+            })
+        before_launched = _counter("tpuml_speculative_launched_total")
+        before_won = _counter("tpuml_speculative_won_total")
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(_job(), "iris", show_progress=False)
+        assert status["job_status"] == "completed"
+        _assert_clean_results(status, 4)
+        assert _counter("tpuml_speculative_launched_total") > before_launched
+        assert _counter("tpuml_speculative_won_total") > before_won
+    finally:
+        cluster.shutdown()
