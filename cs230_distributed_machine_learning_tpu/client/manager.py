@@ -334,6 +334,38 @@ class MLTaskManager:
             return self._coordinator.job_metrics(self.session_id, jid)
         return self._request("get", f"metrics/{self.session_id}/{jid}")
 
+    def explain(
+        self, job_id: Optional[str] = None, subtask_id: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Flight-recorder timeline for one subtask of a job — every
+        scheduling decision in order: placement with its score breakdown,
+        lease grant/reclaim, attempts/retries with reasons and backoff,
+        speculation, and the terminal result (docs/OBSERVABILITY.md
+        "Flight recorder"). ``job_id`` defaults to the latest ``train()``;
+        raises KeyError when the coordinator has no recorded events for
+        the pair (unknown ids or a run under ``CS230_OBS=0``)."""
+        jid = job_id or self.job_id
+        if jid is None or subtask_id is None:
+            raise TypeError(
+                "explain() requires a job id (or a prior train()) and a "
+                "subtask_id"
+            )
+        if self._coordinator is not None:
+            return self._coordinator.explain(jid, subtask_id)
+        import requests
+
+        try:
+            return self._request("get", f"explain/{jid}/{subtask_id}")
+        except requests.HTTPError as e:
+            if e.response is not None and e.response.status_code == 404:
+                # same contract as local mode: absence is a KeyError, not
+                # a transport error
+                raise KeyError(
+                    f"no recorded events for subtask {subtask_id!r} of "
+                    f"job {jid!r}"
+                ) from e
+            raise
+
     def best_result(self, job_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
         status = self.check_status(job_id)
         result = status.get("job_result") or {}

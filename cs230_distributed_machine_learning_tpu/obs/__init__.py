@@ -7,7 +7,7 @@ valve:
     CS230_OBS=0   -> every helper below is a near-free no-op (one env
                      read); ``span()`` yields a shared inert handle.
 
-The two subsystems:
+The subsystems:
 
 - :mod:`.metrics` — thread-safe counters/gauges/histograms exposed in
   Prometheus text format at ``GET /metrics/prom``. The full family
@@ -16,6 +16,12 @@ The two subsystems:
 - :mod:`.tracing` — Dapper-style spans with ``trace_id`` propagated over
   the REST control plane (``X-Trace-Id`` header, task-spec stamping,
   agent span shipping); ``GET /trace/<job_id>`` returns the span tree.
+- :mod:`.recorder` — the flight recorder: bounded per-subtask lifecycle
+  events (placement score breakdowns, lease grant/reclaim, retries,
+  speculation, quarantine) behind ``GET /explain/<job>/<subtask>`` and
+  ``GET /events``.
+- :mod:`.timeseries` — an embedded in-memory time-series ring sampling
+  the registry on the sweep/scrape cadence; ``GET /metrics/history``.
 
 Usage (hot paths pay one env check when disabled):
 
@@ -32,6 +38,7 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from .metrics import (  # noqa: F401 — re-exported API
+    CALIBRATION_BUCKETS,
     DEFAULT_BUCKETS,
     PLACEMENT_BUCKETS,
     Counter,
@@ -39,6 +46,16 @@ from .metrics import (  # noqa: F401 — re-exported API
     Histogram,
     MetricsRegistry,
     REGISTRY,
+)
+from .recorder import (  # noqa: F401 — re-exported API
+    RECORDER,
+    FlightRecorder,
+    record_event,
+)
+from .timeseries import (  # noqa: F401 — re-exported API
+    TIMESERIES,
+    TimeSeriesStore,
+    timeseries_sample,
 )
 from .tracing import _enabled as _valve
 from .tracing import (  # noqa: F401 — re-exported API
@@ -242,6 +259,26 @@ def register_catalog() -> None:
         "Circuit-breaker state per worker, labeled by wid (0 closed, "
         "1 half-open; evicted workers' cells are removed)",
     )
+    # ---- predictor calibration (docs/OBSERVABILITY.md "Predictor
+    # calibration") ----
+    h(
+        "tpuml_predictor_abs_rel_error",
+        "Runtime-predictor error per observed subtask: |predicted - "
+        "actual| / actual (dimensionless), labeled by model family",
+        buckets=CALIBRATION_BUCKETS,
+    )
+    g(
+        "tpuml_predictor_calibration_ratio",
+        "EWMA of predicted/actual runtime per model family, labeled by "
+        "model (1.0 = calibrated; >1 overestimates — leases too loose; "
+        "<1 underestimates — false lease reclaims)",
+    )
+    # ---- flight recorder (docs/OBSERVABILITY.md "Flight recorder") ----
+    c(
+        "tpuml_recorder_events_total",
+        "Lifecycle events recorded by the flight recorder, labeled by "
+        "kind (placement, lease.reclaim, attempt, retry, quarantine, ...)",
+    )
 
 
 register_catalog()
@@ -260,6 +297,13 @@ __all__ = [
     "Histogram",
     "DEFAULT_BUCKETS",
     "PLACEMENT_BUCKETS",
+    "CALIBRATION_BUCKETS",
+    "RECORDER",
+    "FlightRecorder",
+    "record_event",
+    "TIMESERIES",
+    "TimeSeriesStore",
+    "timeseries_sample",
     "TRACER",
     "Tracer",
     "TRACE_HEADER",

@@ -44,6 +44,14 @@ PLACEMENT_BUCKETS: Tuple[float, ...] = (
     1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0,
 )
 
+#: dimensionless relative-error buckets for predictor calibration
+#: (|predicted - actual| / actual): 0.05 = within 5%, 10 = off by 10x —
+#: the range spans a well-calibrated predictor through a cold-started one
+CALIBRATION_BUCKETS: Tuple[float, ...] = (
+    0.05, 0.1, 0.2, 0.35, 0.5, 0.75, 1.0, 1.5, 2.0, 3.0, 5.0, 10.0,
+    30.0, 100.0,
+)
+
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
@@ -101,6 +109,12 @@ class Counter:
         with self._lock:
             return self._values.get(_label_key(labels), 0.0)
 
+    def cells(self) -> List[Tuple[Dict[str, str], float]]:
+        """Snapshot of every labeled cell as (labels, value) — the
+        time-series sampler's read path (obs/timeseries.py)."""
+        with self._lock:
+            return [(dict(key), v) for key, v in self._values.items()]
+
     def render(self) -> List[str]:
         out = [
             f"# HELP {self.name} {_escape_help(self.help)}",
@@ -147,6 +161,12 @@ class Gauge:
         """Current label sets with a live cell (introspection/tests)."""
         with self._lock:
             return [dict(key) for key in self._values]
+
+    def cells(self) -> List[Tuple[Dict[str, str], float]]:
+        """Snapshot of every labeled cell as (labels, value) — the
+        time-series sampler's read path (obs/timeseries.py)."""
+        with self._lock:
+            return [(dict(key), v) for key, v in self._values.items()]
 
     def render(self) -> List[str]:
         out = [

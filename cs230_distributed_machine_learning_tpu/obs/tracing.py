@@ -74,6 +74,54 @@ def _journal_enabled() -> bool:
     return os.environ.get("CS230_OBS_JOURNAL", "1") != "0"
 
 
+def _journal_max_bytes() -> int:
+    """Size cap per journal file (spans.jsonl / events.jsonl) before a
+    rotation. Long-lived coordinators used to grow spans.jsonl without
+    bound across sessions; now the file rolls to ``<name>.1`` (one rotated
+    generation kept) when it crosses the cap."""
+    try:
+        return int(float(os.environ.get("CS230_JOURNAL_MAX_MB", "64")) * 1e6)
+    except ValueError:
+        return int(64e6)
+
+
+def journal_dir() -> str:
+    """Resolve the journal directory: ``CS230_JOURNAL_DIR`` pins it to one
+    place regardless of the configured storage root — CI uses it to
+    collect every span/event of a test run (whose fixtures re-root storage
+    per test) into a single uploadable artifact (deploy/ci.sh)."""
+    d = os.environ.get("CS230_JOURNAL_DIR")
+    if not d:
+        from ..utils.config import get_config
+
+        d = get_config().storage.journal_dir
+    return d
+
+
+def journal_append(basename: str, obj: Dict[str, Any]) -> None:
+    """Best-effort size-rotated JSONL append under the journal dir — the
+    shared writer behind the span journal (``spans.jsonl``) and the flight
+    recorder's event journal (``events.jsonl``). Volume is low (dozens of
+    lines per job), so open-append-close per line is acceptable; any
+    filesystem failure silently drops the line (the in-process rings stay
+    authoritative)."""
+    if not _journal_enabled():
+        return
+    try:
+        d = journal_dir()
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, basename)
+        try:
+            if os.path.getsize(path) > _journal_max_bytes():
+                os.replace(path, path + ".1")
+        except OSError:
+            pass  # first write: no file to rotate yet
+        with open(path, "a") as f:
+            f.write(json.dumps(obj, default=str) + "\n")
+    except Exception:  # noqa: BLE001 — observability must never fail a job
+        pass
+
+
 class SpanHandle:
     """Mutable view of an open span: add attributes mid-flight
     (``sp.attrs["n_subtasks"] = 12``) or read ids for manual child spans."""
@@ -198,27 +246,9 @@ class Tracer:
     # ---------------- journal ----------------
 
     def _journal_write(self, span: Dict[str, Any]) -> None:
-        """Best-effort JSONL append under the storage journal dir. Span
-        volume is low (~a dozen per job), so open-append-close per span is
-        acceptable; any filesystem failure silently drops the line (the
-        ring buffer stays authoritative)."""
-        if not _journal_enabled():
-            return
-        try:
-            # CS230_JOURNAL_DIR pins the journal to one place regardless of
-            # the configured storage root — CI uses it to collect every
-            # span of a test run (whose fixtures re-root storage per test)
-            # into a single uploadable artifact (deploy/ci.sh).
-            journal_dir = os.environ.get("CS230_JOURNAL_DIR")
-            if not journal_dir:
-                from ..utils.config import get_config
-
-                journal_dir = get_config().storage.journal_dir
-            os.makedirs(journal_dir, exist_ok=True)
-            with open(os.path.join(journal_dir, "spans.jsonl"), "a") as f:
-                f.write(json.dumps(span, default=str) + "\n")
-        except Exception:  # noqa: BLE001 — observability must never fail a job
-            pass
+        """Size-rotated JSONL append under the storage journal dir (see
+        :func:`journal_append`)."""
+        journal_append("spans.jsonl", span)
 
 
 #: the process-global tracer (coordinator side)

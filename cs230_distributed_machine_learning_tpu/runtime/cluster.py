@@ -24,7 +24,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..obs import counter_inc, gauge_set, observe, process_token
+from ..obs import counter_inc, gauge_set, observe, process_token, record_event
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .executor import DeviceLostError, LocalExecutor
@@ -210,6 +210,12 @@ class ClusterRuntime:
             logger.error(
                 "Subtask %s killed %d worker backends; poisoning it instead "
                 "of requeueing", stid, kills,
+            )
+            record_event(
+                "poison", job_id=task.get("job_id"), subtask_id=stid,
+                worker_id=worker_id,
+                attempt=int(task.get("attempt") or 0),
+                device_losses=kills, threshold=threshold,
             )
             self.engine.release_task(worker_id, stid)
             self.bus.publish(

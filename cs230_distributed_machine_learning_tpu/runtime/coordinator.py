@@ -20,7 +20,16 @@ from typing import Any, Dict, List, Optional
 from ..data.datasets import DatasetCache
 from ..data.download import download_dataset
 from ..data.preprocess import preprocess_dataframe
-from ..obs import TRACER, activate, counter_inc, current_trace_id, new_trace_id, span
+from ..obs import (
+    RECORDER,
+    TRACER,
+    activate,
+    counter_inc,
+    current_trace_id,
+    new_trace_id,
+    record_event,
+    span,
+)
 from ..utils.config import FrameworkConfig, get_config
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
@@ -257,6 +266,14 @@ class Coordinator:
 
         def on_result(subtask_id: str, status: str, result: Optional[Dict[str, Any]]):
             self.store.update_subtask(sid, job_id, subtask_id, status, result)
+            r = result or {}
+            record_event(
+                "result", job_id=job_id, subtask_id=subtask_id,
+                worker_id=r.get("worker_id"),
+                attempt=int(r.get("attempt") or 0), status=status,
+                mean_cv_score=r.get("mean_cv_score"),
+                error=r.get("error"),
+            )
             self.bus.publish(TOPIC_RESULTS, result, key=subtask_id)
 
         def on_metrics(msg: Dict[str, Any]):
@@ -389,6 +406,12 @@ class Coordinator:
                     # cancellation ("first terminal result wins")
                     if ledger.was_speculated(stid):
                         counter_inc("tpuml_speculative_wasted_total")
+                        record_event(
+                            "speculate.loss", job_id=job_id,
+                            subtask_id=stid,
+                            worker_id=result.get("worker_id"),
+                            attempt=int(result.get("attempt") or 0),
+                        )
                     continue
                 if result.get("status", "completed") != "failed":
                     pending.discard(stid)
@@ -396,6 +419,11 @@ class Coordinator:
                     results[wanted[stid]] = result
                     if result.get("speculative"):
                         counter_inc("tpuml_speculative_won_total")
+                        record_event(
+                            "speculate.win", job_id=job_id, subtask_id=stid,
+                            worker_id=result.get("worker_id"),
+                            attempt=int(result.get("attempt") or 0),
+                        )
                     on_result(stid, "completed", result)
                     last_progress = time.time()
                     continue
@@ -405,6 +433,11 @@ class Coordinator:
                     # a newer attempt (lease reclaim / speculation) owns
                     # this subtask now; the old attempt's failure must not
                     # consume budget
+                    record_event(
+                        "result.stale", job_id=job_id, subtask_id=stid,
+                        worker_id=result.get("worker_id"), attempt=attempt,
+                        error=result.get("error"),
+                    )
                     continue
                 wid = result.get("worker_id")
                 entry = ledger.record_failure(stid, wid)
@@ -429,6 +462,14 @@ class Coordinator:
                               subtask_id=stid, attempts=entry.failures,
                               reason=quarantined["quarantine_reason"]):
                         pass
+                    record_event(
+                        "quarantine", job_id=job_id, subtask_id=stid,
+                        worker_id=wid, attempt=attempt,
+                        reason=quarantined["quarantine_reason"],
+                        attempts=entry.failures,
+                        device_losses=entry.device_losses,
+                        error=result.get("error"),
+                    )
                     pending.discard(stid)
                     ledger.mark_done(stid)
                     results[wanted[stid]] = quarantined
@@ -456,6 +497,14 @@ class Coordinator:
                               attempt=task["attempt"], backoff_s=backoff,
                               excluded_worker=wid):
                         pass
+                    record_event(
+                        "retry", job_id=job_id, subtask_id=stid,
+                        worker_id=wid, attempt=task["attempt"],
+                        reason="failure", backoff_s=backoff,
+                        failures=entry.failures,
+                        max_attempts=cfg.retry_max_attempts,
+                        error=result.get("error"),
+                    )
                     retry_due.append((time.time() + backoff, task))
                 last_progress = time.time()
             return results  # type: ignore[return-value]
@@ -662,6 +711,41 @@ class Coordinator:
             "device_peak_flops": peak,
             "groups": groups,
         }
+
+    def explain(self, job_id: str, subtask_id: str) -> Dict[str, Any]:
+        """Flight-recorder timeline for one subtask — every lifecycle
+        decision in order (placement with score breakdown, lease grant/
+        reclaim, attempts, retries, speculation, terminal result /
+        quarantine). Raises KeyError when the recorder never saw the pair
+        (unknown ids, a run under ``CS230_OBS=0``, or a timeline already
+        evicted from the bounded ring) — the ``GET /explain`` 404. Schema:
+        docs/OBSERVABILITY.md "Flight recorder"."""
+        timeline = RECORDER.timeline(job_id, subtask_id)
+        if timeline is None:
+            raise KeyError(
+                f"no recorded events for subtask {subtask_id!r} of job "
+                f"{job_id!r}"
+            )
+        return {
+            "job_id": job_id,
+            "subtask_id": subtask_id,
+            "n_events": len(timeline),
+            "events": timeline,
+        }
+
+    def predictor_calibration(self) -> Dict[str, Any]:
+        """Per-model-family predicted-vs-actual calibration of the runtime
+        predictor driving placement/lease decisions — the
+        ``GET /predictor/calibration`` body. Empty ``families`` in direct
+        mode (no placement engine ran, nothing was predicted)."""
+        families: Dict[str, Any] = {}
+        if self.cluster is not None:
+            report = getattr(
+                self.cluster.engine.predictor, "calibration_report", None
+            )
+            if report is not None:
+                families = report()
+        return {"families": families, "n_families": len(families)}
 
     def wait_for_completion(self, sid: str, job_id: str, timeout_s: Optional[float] = None) -> Dict[str, Any]:
         timeout = timeout_s or self.config.service.client_timeout_s

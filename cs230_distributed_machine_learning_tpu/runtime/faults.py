@@ -36,6 +36,7 @@ import dataclasses
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from ..obs import record_event
 from ..utils.logging import get_logger
 
 logger = get_logger("tpuml.faults")
@@ -130,6 +131,17 @@ class AttemptLedger:
             if speculative:
                 task["speculative"] = True
             snap = self._snapshot(e)
+        # flight-recorder breadcrumb for EVERY re-dispatch stamp — lease
+        # reclaims, failure retries, dead-worker requeues, speculation —
+        # since every path funnels through here (docs/OBSERVABILITY.md
+        # "Flight recorder")
+        record_event(
+            "attempt",
+            job_id=task.get("job_id"), subtask_id=stid,
+            attempt=snap.attempt, reason=reason,
+            excluded_worker=exclude_worker, failures=snap.failures,
+            excluded=list(snap.excluded), speculative=bool(speculative),
+        )
         hook = self.on_attempt
         if hook is not None:
             try:

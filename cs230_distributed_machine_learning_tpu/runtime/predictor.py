@@ -9,17 +9,32 @@ samples, with per-algorithm multipliers from config. Here the observations
 come from executor device timings instead of Kafka ``metrics`` messages,
 and a trial batch's predicted runtime feeds the placement score the same
 way the reference's did.
+
+Beyond the reference: **calibration telemetry**. Since the fault-tolerance
+layer (docs/ROBUSTNESS.md) derives lease deadlines, reclaim decisions,
+speculation triggers, and (via the placement score) breaker exposure from
+these estimates, a drifting predictor now causes false lease reclaims
+that silently burn retry budgets. ``record_calibration`` keeps bounded
+per-model-family predicted-vs-actual error windows (fed by the
+scheduler's observe path with the EXACT estimate that drove the placement
+— algo multiplier included), publishes them as
+``tpuml_predictor_abs_rel_error{model=}`` /
+``tpuml_predictor_calibration_ratio{model=}``, and
+``calibration_report()`` backs ``GET /predictor/calibration``
+(docs/OBSERVABILITY.md "Predictor calibration").
 """
 
 from __future__ import annotations
 
 import collections
 import os
+import statistics
 import threading
 from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ..obs import gauge_set, observe
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 
@@ -28,6 +43,12 @@ logger = get_logger("tpuml.predictor")
 
 class RuntimePredictor:
     N_FEATURES = 7
+
+    #: per-model-family calibration window: the last N (predicted, actual)
+    #: pairs back the error percentiles in calibration_report()
+    CALIB_WINDOW = 256
+    #: EWMA smoothing for the per-family predicted/actual ratio gauge
+    CALIB_EMA_ALPHA = 0.2
 
     #: replay-buffer depth: every refit trains on the last N observations,
     #: not just the latest 10-sample batch. The reference refit on each
@@ -54,6 +75,10 @@ class RuntimePredictor:
         self._history: collections.deque = collections.deque(
             maxlen=int(replay_size or self.REPLAY_SIZE)
         )
+        #: model family -> deque[(predicted_s, actual_s)] (CALIB_WINDOW)
+        self._calib: Dict[str, collections.deque] = {}
+        #: model family -> EWMA of predicted/actual
+        self._calib_ratio: Dict[str, float] = {}
         self._model = self._load_or_init()
 
     # ---------------- features ----------------
@@ -95,6 +120,71 @@ class RuntimePredictor:
             self._pending = 0
             replay = list(self._history)
         self._refit(replay)
+
+    # ---------------- calibration ----------------
+
+    def record_calibration(
+        self, model_type: Optional[str], predicted_s: float, actual_s: float
+    ) -> None:
+        """Record one predicted-vs-actual pair for ``model_type``. Called
+        by the scheduler's metrics-feedback path with the estimate that
+        actually drove the placement (and thus the lease deadline), so the
+        report measures the predictor AS USED, not a recomputation."""
+        if not (predicted_s > 0 and actual_s > 0):
+            return
+        if "_calib" not in self.__dict__:
+            # a stub subclass constructed without RuntimePredictor.__init__
+            # (deterministic test predictors) carries no calibration state
+            return
+        family = str(model_type or "unknown")
+        ratio = predicted_s / actual_s
+        with self._lock:
+            window = self._calib.get(family)
+            if window is None:
+                window = collections.deque(maxlen=self.CALIB_WINDOW)
+                self._calib[family] = window
+            window.append((float(predicted_s), float(actual_s)))
+            a = self.CALIB_EMA_ALPHA
+            prev = self._calib_ratio.get(family)
+            ewma = ratio if prev is None else (1 - a) * prev + a * ratio
+            self._calib_ratio[family] = ewma
+        observe(
+            "tpuml_predictor_abs_rel_error",
+            abs(predicted_s - actual_s) / actual_s,
+            model=family,
+        )
+        gauge_set("tpuml_predictor_calibration_ratio", ewma, model=family)
+
+    def calibration_report(self) -> Dict[str, Dict[str, Any]]:
+        """Per-model-family calibration stats over the bounded window —
+        the ``GET /predictor/calibration`` body. ``ratio`` figures are
+        predicted/actual (1.0 = calibrated; < 1 underestimates, which
+        tightens leases toward false reclaims); ``abs_rel_error`` is
+        |predicted - actual| / actual."""
+        if "_calib" not in self.__dict__:
+            # stub subclass without RuntimePredictor.__init__ (see
+            # record_calibration): no state, empty report
+            return {}
+        with self._lock:
+            windows = {f: list(w) for f, w in self._calib.items()}
+            ewmas = dict(self._calib_ratio)
+        report: Dict[str, Dict[str, Any]] = {}
+        for family, pairs in sorted(windows.items()):
+            ratios = sorted(p / a for p, a in pairs)
+            errors = sorted(abs(p - a) / a for p, a in pairs)
+            last_p, last_a = pairs[-1]
+            report[family] = {
+                "n": len(pairs),
+                "ratio_ewma": ewmas.get(family),
+                "ratio_median": statistics.median(ratios),
+                "abs_rel_error_mean": statistics.fmean(errors),
+                "abs_rel_error_p90": errors[
+                    min(int(0.9 * len(errors)), len(errors) - 1)
+                ],
+                "last_predicted_s": last_p,
+                "last_actual_s": last_a,
+            }
+        return report
 
     def _refit(self, batch) -> None:
         from sklearn.ensemble import GradientBoostingRegressor

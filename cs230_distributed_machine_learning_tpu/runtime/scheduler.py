@@ -49,7 +49,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
-from ..obs import counter_inc, gauge_set, observe, span
+from ..obs import (
+    counter_inc,
+    gauge_set,
+    obs_enabled,
+    observe,
+    record_event,
+    span,
+    timeseries_sample,
+)
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .faults import AttemptLedger
@@ -201,6 +209,7 @@ class PlacementEngine:
         ``breaker_max_trips`` trips (docs/ROBUSTNESS.md)."""
         cfg = self.cfg
         evict = False
+        transition = None  # (from_state, to_state, trips) for the recorder
         with self._lock:
             w = self.workers.get(worker_id)
             if w is None:
@@ -217,6 +226,7 @@ class PlacementEngine:
                 if ok:
                     w.breaker_state = "closed"
                     w.window_ok = w.window_failed = 0
+                    transition = ("half_open", "closed", w.breaker_trips)
                     gauge_set(
                         "tpuml_worker_breaker_state", 0.0, wid=worker_id
                     )
@@ -227,6 +237,7 @@ class PlacementEngine:
                     w.breaker_trips += 1
                     w.window_ok = w.window_failed = 0
                     evict = w.breaker_trips >= cfg.breaker_max_trips
+                    transition = ("half_open", "half_open", w.breaker_trips)
                     logger.warning(
                         "Worker %s breaker probe failed (trip %d/%d)",
                         worker_id, w.breaker_trips, cfg.breaker_max_trips,
@@ -249,6 +260,7 @@ class PlacementEngine:
                     w.breaker_state = "half_open"
                     w.breaker_trips += 1
                     w.window_ok = w.window_failed = 0
+                    transition = ("closed", "half_open", w.breaker_trips)
                     gauge_set(
                         "tpuml_worker_breaker_state", 1.0, wid=worker_id
                     )
@@ -258,6 +270,14 @@ class PlacementEngine:
                         worker_id, w.breaker_trips, cfg.breaker_max_trips,
                     )
                     evict = w.breaker_trips >= cfg.breaker_max_trips
+        if transition is not None:
+            from_state, to_state, trips = transition
+            record_event(
+                "breaker.transition", worker_id=worker_id,
+                **{"from": from_state, "to": to_state, "trips": trips,
+                   "max_trips": cfg.breaker_max_trips,
+                   "evicting": bool(evict)},
+            )
         if evict:
             self.evict_worker(worker_id)
 
@@ -296,6 +316,11 @@ class PlacementEngine:
         logger.warning(
             "Worker %s evicted (%s); requeueing %d tasks",
             worker_id, reason, len(state.tasks_queue),
+        )
+        record_event(
+            "worker.evict", worker_id=worker_id, reason=reason,
+            n_requeued=len(state.tasks_queue),
+            breaker_trips=state.breaker_trips,
         )
         self._drop_worker_gauges(worker_id)
         hook = self.on_evict
@@ -441,9 +466,15 @@ class PlacementEngine:
         t_place = time.perf_counter()
         est = self.predictor.predict(task)
         mem_mb = float(task.get("mem_estimate_mb", 1.0))
+        # flight-recorder explainability: the full decision — per-candidate
+        # scores, exclusions, penalties, the lease — is captured only when
+        # obs is on (the breakdown dicts are not free, the decision is)
+        explain = obs_enabled()
+        breakdown: Optional[Dict[str, Any]] = None
         with self._lock:
             if not self.workers:
                 return None
+            mem_fallback = False
             eligible = [
                 w
                 for w in self.workers.values()
@@ -456,10 +487,12 @@ class PlacementEngine:
                     mem_mb,
                 )
                 eligible = list(self.workers.values())
+                mem_fallback = True
             # excluded-worker memory (retries must not land on the worker
             # that just failed/hung the task) — a preference, not a gate:
             # when only excluded workers remain, liveness wins
             excluded = set(task.get("excluded_workers") or ())
+            excluded_overridden = False
             if excluded:
                 non_excluded = [
                     w for w in eligible if w.worker_id not in excluded
@@ -467,6 +500,7 @@ class PlacementEngine:
                 if non_excluded:
                     eligible = non_excluded
                 else:
+                    excluded_overridden = True
                     logger.warning(
                         "Every eligible worker is excluded for %s; "
                         "falling back to the excluded pool",
@@ -489,40 +523,104 @@ class PlacementEngine:
             # put O(W^2 log W) work on the hot path this module times.
             stragglers = self._flagged
             penalty = self.cfg.straggler_penalty_s
-            best = min(
-                eligible,
-                key=lambda w: w.effective_finish_time()
-                + est / max(w.speed_factor, 1e-3)
-                + (penalty if w.worker_id in stragglers else 0.0),
-            )
+
+            def _score(w: WorkerState) -> float:
+                return (
+                    w.effective_finish_time()
+                    + est / max(w.speed_factor, 1e-3)
+                    + (penalty if w.worker_id in stragglers else 0.0)
+                )
+
+            best = min(eligible, key=_score)
+            stid = task.get("subtask_id")
+            if explain:
+                # snapshot the score terms BEFORE the books absorb this
+                # task — the breakdown must show the inputs of the
+                # decision, not its side effects
+                ranked = sorted(eligible, key=_score)[:8]
+                breakdown = {
+                    "est_runtime_s": est,
+                    "mem_estimate_mb": mem_mb,
+                    "n_workers": len(self.workers),
+                    "n_eligible": len(eligible),
+                    "mem_fallback": mem_fallback,
+                    "excluded": sorted(excluded),
+                    "excluded_overridden": excluded_overridden,
+                    "penalized": sorted(
+                        w.worker_id for w in eligible
+                        if w.worker_id in stragglers
+                    ),
+                    "chosen_score": _score(best),
+                    "candidates": [
+                        {
+                            "worker_id": w.worker_id,
+                            "score": _score(w),
+                            "effective_finish_time_s":
+                                w.effective_finish_time(),
+                            "est_over_speed_s":
+                                est / max(w.speed_factor, 1e-3),
+                            "speed_factor": w.speed_factor,
+                            "load_seconds": w.load_seconds,
+                            "mem_load_mb": w.mem_load_mb,
+                            "queue_depth": len(w.tasks_queue),
+                            "penalty_s": penalty
+                            if w.worker_id in stragglers else 0.0,
+                            "breaker_state": w.breaker_state,
+                        }
+                        for w in ranked
+                    ],
+                }
             best.load_seconds += est
             best.mem_load_mb += mem_mb
             best.tasks_queue.append(task)
-            stid = task.get("subtask_id")
             best.task_est[stid] = est
             best.task_mem[stid] = mem_mb
             now = time.time()
             best.task_placed_at[stid] = now
+            lease_deadline = None
             if self.cfg.lease_factor > 0:
                 # lease covers the PREDICTED completion time on this worker
                 # — queue wait included (effective_finish_time already
                 # absorbed this task's estimate above), speed-adjusted —
                 # so deep queues don't expire healthy leases; the floor
                 # absorbs cold-start noise
-                best.task_lease[stid] = now + max(
+                lease_deadline = now + max(
                     self.cfg.lease_floor_s,
                     self.cfg.lease_factor * best.effective_finish_time(),
                 )
+                best.task_lease[stid] = lease_deadline
             wid = best.worker_id
         elapsed = time.perf_counter() - t_place
         observe("tpuml_scheduler_placement_seconds", elapsed)
         counter_inc("tpuml_subtasks_dispatched_total")
+        attempt = int(task.get("attempt") or 0)
+        if breakdown is not None:
+            record_event(
+                "placement",
+                job_id=task.get("job_id"),
+                subtask_id=stid,
+                worker_id=wid,
+                attempt=attempt,
+                **breakdown,
+            )
+            if lease_deadline is not None:
+                record_event(
+                    "lease.grant",
+                    job_id=task.get("job_id"),
+                    subtask_id=stid,
+                    worker_id=wid,
+                    attempt=attempt,
+                    deadline_ts=lease_deadline,
+                    lease_s=lease_deadline - now,
+                    lease_factor=self.cfg.lease_factor,
+                    lease_floor_s=self.cfg.lease_floor_s,
+                )
         tid = task.get("trace_id")
         if tid:
             # the decision already ran: back-date the span over it
             with span("schedule.place", trace_id=tid, parent_id=None,
                       subtask_id=stid, worker=wid, est_runtime_s=est,
-                      attempt=int(task.get("attempt") or 0)) as sp:
+                      attempt=attempt) as sp:
                 sp.start = time.time() - elapsed
         if self.bus is not None:
             self.bus.publish(TOPIC_TRAIN, task, key=wid)
@@ -575,6 +673,18 @@ class PlacementEngine:
                 w.n_batches += 1
         if actual is not None:
             self.predictor.observe(msg, actual)
+            if est > 0:
+                # calibration telemetry: est is the exact estimate the
+                # placement consumed (algo multiplier included) and the
+                # lease was derived from — measure the predictor AS USED.
+                # getattr: engine-level tests run stub predictors without
+                # the calibration surface.
+                rec = getattr(self.predictor, "record_calibration", None)
+                if rec is not None:
+                    # executor metrics messages carry the family as "algo"
+                    # (reference schema); synthetic test feedback uses
+                    # "model_type"
+                    rec(msg.get("algo") or msg.get("model_type"), est, actual)
             if batch_once:
                 self.refresh_health_metrics()
 
@@ -624,10 +734,10 @@ class PlacementEngine:
                     w.task_placed_at.pop(stid, None)
                     w.load_seconds = max(0.0, w.load_seconds - est)
                     w.mem_load_mb = max(0.0, w.mem_load_mb - mem)
-                    reclaimed.append((wid, task))
+                    reclaimed.append((wid, task, now - deadline))
             if dead:
                 gauge_set("tpuml_workers_alive", len(self.workers))
-        for wid, task in reclaimed:
+        for wid, task, overdue_s in reclaimed:
             stid = task.get("subtask_id")
             if stid and self.ledger.is_done(stid):
                 continue  # a duplicate attempt already delivered a result
@@ -639,6 +749,16 @@ class PlacementEngine:
             # ingest counts it and quarantines) instead of a re-dispatch.
             entry = self.ledger.get(stid)
             failures_so_far = entry.failures if entry is not None else 0
+            record_event(
+                "lease.reclaim",
+                job_id=task.get("job_id"), subtask_id=stid, worker_id=wid,
+                attempt=int(task.get("attempt") or 0),
+                overdue_s=round(overdue_s, 3),
+                failures_so_far=failures_so_far,
+                budget_exhausted=(
+                    failures_so_far + 1 >= self.cfg.retry_max_attempts
+                ),
+            )
             if failures_so_far + 1 >= self.cfg.retry_max_attempts:
                 logger.error(
                     "Lease expired for %s on %s and its retry budget is "
@@ -680,11 +800,20 @@ class PlacementEngine:
                 self.cfg.dead_after_s,
                 len(w.tasks_queue),
             )
+            record_event(
+                "worker.dead", worker_id=w.worker_id,
+                heartbeat_silence_s=round(now - w.last_heartbeat, 3),
+                n_requeued=len(w.tasks_queue),
+            )
             self._drop_worker_gauges(w.worker_id)
             self._requeue(w.tasks_queue, from_worker=w.worker_id)
         self._speculate()
         if dead or reclaimed:
             self.refresh_health_metrics()
+        # one time-series sample per sweep: the embedded metrics history
+        # rides the cadence every other periodic decision already runs on
+        # (obs/timeseries.py; throttled, no-op when disabled)
+        timeseries_sample()
         return [w.worker_id for w in dead]
 
     def _speculate(self) -> List[Dict[str, Any]]:
@@ -755,6 +884,13 @@ class PlacementEngine:
                 "Speculating duplicate of %s (in-flight %.1fs on %s, "
                 "attempt %d)",
                 task.get("subtask_id"), age, owner, task["attempt"],
+            )
+            record_event(
+                "speculate.launch",
+                job_id=task.get("job_id"),
+                subtask_id=task.get("subtask_id"),
+                worker_id=owner, attempt=task["attempt"],
+                in_flight_s=round(age, 3),
             )
             tid = task.get("trace_id")
             if tid:

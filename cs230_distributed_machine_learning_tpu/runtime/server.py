@@ -18,6 +18,8 @@ import json
 from typing import Optional
 
 from ..obs import (
+    RECORDER,
+    TIMESERIES,
     TRACE_HEADER,
     TRACER,
     activate,
@@ -26,6 +28,7 @@ from ..obs import (
     obs_enabled,
     render_prometheus,
     span,
+    timeseries_sample,
 )
 from ..utils.serialization import json_safe
 from .coordinator import Coordinator
@@ -46,14 +49,19 @@ _DASHBOARD_HTML = """<!doctype html>
 <h1>tpuml coordinator</h1>
 <div id="meta">health: <span id="health">…</span> · refreshed <span id="ts">never</span>
  · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code>
- <code>/metrics/prom</code> <code>/trace/&lt;job_id&gt;</code> <code>/cost/&lt;job_id&gt;</code>
- <code>/healthz</code></div>
+ <code>/metrics/prom</code> <code>/metrics/history?name=</code> <code>/trace/&lt;job_id&gt;</code>
+ <code>/cost/&lt;job_id&gt;</code> <code>/explain/&lt;job_id&gt;/&lt;subtask_id&gt;</code>
+ <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code></div>
 <h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
 <th>status</th><th>done</th><th>failed</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
 <h2>Latest job trace</h2>
 <div id="trace" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no trace yet</div>
 <h2>Latest job cost</h2>
 <div id="cost" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no cost data yet</div>
+<h2>Metrics history</h2>
+<div id="spark" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no samples yet</div>
+<h2>Flight recorder (latest events)</h2>
+<table id="events"><thead></thead><tbody></tbody></table>
 <h2>Workers</h2><table id="workers"><thead></thead><tbody></tbody></table>
 <h2>Queues</h2><table id="queues"><thead></thead><tbody></tbody></table>
 <h2>Supervised agents</h2><table id="sup"><thead></thead><tbody></tbody></table>
@@ -142,9 +150,63 @@ function renderCost(el, c){
       `<td>${fmt(g.hbm_peak_bytes)}</td></tr>`).join("") +
     `</tbody></table>`;
 }
+// sparkline panels over GET /metrics/history (the embedded time-series
+// ring, obs/timeseries.py): per-worker queue depth and breaker state,
+// the retry RATE derived from the counter's samples, and MFU per model
+const SPARKS = [
+  {name: "tpuml_worker_queue_depth", title: "queue depth", mode: "raw"},
+  {name: "tpuml_subtasks_retried_total", title: "retries/s", mode: "rate"},
+  {name: "tpuml_worker_breaker_state", title: "breaker state", mode: "raw"},
+  {name: "tpuml_executor_mfu", title: "MFU", mode: "raw"},
+];
+function sparkSvg(pts){
+  if (pts.length < 2) return "";
+  const t0 = pts[0][0], t1 = pts[pts.length - 1][0];
+  const vs = pts.map(p => p[1]);
+  const vmin = Math.min(...vs, 0), vmax = Math.max(...vs);
+  const W = 160, H = 26;
+  const poly = pts.map(([t, v]) =>
+    `${(W * (t - t0) / Math.max(t1 - t0, 1e-9)).toFixed(1)},` +
+    `${(H - 2 - (H - 4) * (v - vmin) / Math.max(vmax - vmin, 1e-9)).toFixed(1)}`
+  ).join(" ");
+  return `<svg width="${W}" height="${H}" style="background:#f4f4f4;vertical-align:middle">` +
+    `<polyline points="${poly}" fill="none" stroke="#4a7fb5" stroke-width="1.5"/></svg>`;
+}
+// counter samples -> per-interval rate (clamped at 0: restarts reset)
+const rate = s => s.slice(1).map((p, i) =>
+  [p[0], Math.max(p[1] - s[i][1], 0) / Math.max(p[0] - s[i][0], 1e-9)]);
+async function renderSparks(el){
+  const blocks = await Promise.all(SPARKS.map(async p => {
+    const h = await get(`/metrics/history?name=${p.name}`);
+    const series = ((h && h.series) || []).filter(s => s.samples.length > 1);
+    if (!series.length) return "";
+    return `<div style="margin:2px 0"><b>${esc(p.title)}</b> ` +
+      series.slice(0, 8).map(s => {
+        const pts = p.mode === "rate" ? rate(s.samples) : s.samples;
+        if (!pts.length) return "";
+        const last = pts[pts.length - 1][1];
+        const lbl = Object.values(s.labels).join(",") || "total";
+        return `<span style="margin-right:12px;white-space:nowrap">` +
+          `${esc(lbl)} ${sparkSvg(pts)} <code>${(+last).toPrecision(3)}</code></span>`;
+      }).join("") + `</div>`;
+  }));
+  const html = blocks.filter(Boolean).join("");
+  el.innerHTML = html || "no samples yet";
+}
+// flight-recorder feed: the newest events, newest first
+async function renderEvents(el, ev){
+  const rows = ((ev && ev.events) || []).slice(-15).reverse().map(e => ({
+    seq: e.seq, kind: e.kind,
+    subtask: e.subtask_id ? `${(e.job_id || "").slice(0, 8)}/${e.subtask_id}` : "",
+    worker: e.worker_id || "", attempt: e.attempt == null ? "" : e.attempt,
+    detail: JSON.stringify(e.data).slice(0, 120),
+  }));
+  listTable(el, rows);
+}
 async function tick(){
-  const [h, jobs, workers, queues, sup] = await Promise.all(
-    ["/health", "/jobs", "/workers", "/queues", "/supervisor"].map(get));
+  const [h, jobs, workers, queues, sup, ev] = await Promise.all(
+    ["/health", "/jobs", "/workers", "/queues", "/supervisor",
+     "/events?limit=500"].map(get));
   const he = document.getElementById("health");
   he.textContent = h ? h.status : "unreachable";
   he.className = h && h.status === "ok" ? "ok" : "bad";
@@ -158,6 +220,8 @@ async function tick(){
   kvTable(document.getElementById("workers"), workers);
   kvTable(document.getElementById("queues"), queues);
   listTable(document.getElementById("sup"), sup);
+  renderEvents(document.getElementById("events"), ev);
+  await renderSparks(document.getElementById("spark"));
   const latest = Array.isArray(jobs) && jobs.length ? jobs[0].job_id : null;
   renderTrace(document.getElementById("trace"),
               latest ? await get(`/trace/${latest}`) : null);
@@ -207,6 +271,17 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/trace_spans/<wid>", endpoint="trace_spans", methods=["POST"]),
             Rule("/cost/<jid>", endpoint="cost", methods=["GET"]),
             Rule("/healthz", endpoint="healthz", methods=["GET"]),
+            # flight recorder + explainability (docs/OBSERVABILITY.md
+            # "Flight recorder"): per-subtask decision timelines, the
+            # event firehose, predictor calibration, and the embedded
+            # metrics time-series history
+            Rule("/explain/<jid>/<stid>", endpoint="explain", methods=["GET"]),
+            Rule("/explain/<jid>", endpoint="explain_job", methods=["GET"]),
+            Rule("/events", endpoint="events", methods=["GET"]),
+            Rule("/metrics/history", endpoint="metrics_history",
+                 methods=["GET"]),
+            Rule("/predictor/calibration", endpoint="predictor_calibration",
+                 methods=["GET"]),
             # worker-agent control plane (reference scheduler.py:95-159)
             Rule("/subscribe", endpoint="subscribe", methods=["POST"]),
             Rule("/unsubscribe/<wid>", endpoint="unsubscribe", methods=["POST"]),
@@ -258,8 +333,12 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /jobs",
                     "GET  /dashboard  (HTML)",
                     "GET  /metrics/prom  (Prometheus exposition)",
+                    "GET  /metrics/history?name=&since=  (embedded time series)",
                     "GET  /trace/<job_id>  (span tree)",
                     "GET  /cost/<job_id>  (device cost report)",
+                    "GET  /explain/<job_id>/<subtask_id>  (decision timeline)",
+                    "GET  /events?since=&limit=  (flight-recorder firehose)",
+                    "GET  /predictor/calibration  (predicted-vs-actual stats)",
                     "GET  /health",
                     "GET  /healthz  (deep health: device, workers, stragglers)",
                 ],
@@ -337,6 +416,10 @@ def create_app(coordinator: Optional[Coordinator] = None):
         from .executor import record_hbm_gauges
 
         record_hbm_gauges()
+        # each scrape also feeds the embedded time-series ring (throttled;
+        # the sweep is the other driver) — direct-mode coordinators have
+        # no sweep loop, so history still accumulates at scrape cadence
+        timeseries_sample()
         return Response(
             render_prometheus(),
             content_type="text/plain; version=0.0.4; charset=utf-8",
@@ -405,6 +488,68 @@ def create_app(coordinator: Optional[Coordinator] = None):
             if slots and out["agent_slots"]["gave_up"] == len(slots):
                 out["status"] = "degraded"
         return _json(out)
+
+    def explain(request, jid, stid):
+        """Per-subtask decision timeline from the flight recorder: who
+        placed it where and why (score breakdown), lease grant/reclaim,
+        attempts/retries, speculation, terminal result — 404 when the
+        recorder never saw the pair."""
+        try:
+            return _json(coord.explain(jid, stid))
+        except KeyError as e:
+            return _json(
+                {"status": "error", "message": str(e).strip("'")}, status=404
+            )
+
+    def explain_job(request, jid):
+        """Subtask ids with a recorded timeline for the job — the
+        discovery aid for /explain/<jid>/<stid>."""
+        stids = RECORDER.job_subtasks(jid)
+        if not stids:
+            return _json(
+                {"status": "error",
+                 "message": f"no recorded events for job {jid!r}"},
+                status=404,
+            )
+        return _json({"job_id": jid, "subtask_ids": stids})
+
+    def events(request):
+        """Flight-recorder firehose: events with seq > ?since= (oldest
+        first, at most ?limit=). ``last_seq`` is the cursor for the next
+        poll."""
+        def _int_arg(name, default):
+            try:
+                return int(request.args.get(name, default))
+            except ValueError:
+                return default  # a malformed value falls back alone
+
+        since = _int_arg("since", 0)
+        limit = _int_arg("limit", 1000)
+        evts, last = RECORDER.events(since=since, limit=limit)
+        return _json({"events": evts, "n_events": len(evts), "last_seq": last})
+
+    def metrics_history(request):
+        """Embedded time-series read (obs/timeseries.py): ?name= selects a
+        metric family, ?since= (epoch seconds) trims old samples. Without
+        ?name=, lists the sampled family names."""
+        name = request.args.get("name")
+        if not name:
+            return _json({"names": TIMESERIES.names()})
+        try:
+            since = float(request.args.get("since", 0.0))
+        except ValueError:
+            since = 0.0
+        return _json({
+            "name": name,
+            "since": since,
+            "series": TIMESERIES.history(name, since=since),
+        })
+
+    def predictor_calibration(request):
+        """Per-model-family predicted-vs-actual calibration of the
+        runtime predictor (docs/OBSERVABILITY.md "Predictor
+        calibration")."""
+        return _json(coord.predictor_calibration())
 
     def trace(request, jid):
         tid = TRACER.trace_for_job(jid)

@@ -15,10 +15,11 @@
 #   reclaim, SPMD host loss, supervisor restart policy — which the fast
 #   gate never runs.
 #
-# On a RED suite the trace/metric record of the run is preserved under
-# $CI_ARTIFACTS_DIR (default ci-artifacts/) so failures are diagnosable
-# from the span journal and a Prometheus snapshot instead of rerun
-# archaeology; ci.yml uploads the directory as a workflow artifact.
+# On a RED suite the trace/metric/decision record of the run is preserved
+# under $CI_ARTIFACTS_DIR (default ci-artifacts/) so failures are
+# diagnosable from the span journal, the flight-recorder event journal,
+# and a Prometheus snapshot instead of rerun archaeology; ci.yml uploads
+# the directory as a workflow artifact.
 # Wall time of the fast suite on the dev box is recorded in
 # docs/STATUS.md; keep the two in sync when it moves.
 set -euo pipefail
@@ -30,16 +31,21 @@ ART_DIR="${CI_ARTIFACTS_DIR:-ci-artifacts}"
 echo "== lint gate: python -m compileall =="
 python -m compileall -q cs230_distributed_machine_learning_tpu tests benchmarks
 
-# CS230_JOURNAL_DIR: every span of the whole run lands in ONE journal
-# (tests re-root storage per test, which would scatter-then-delete it);
+# CS230_JOURNAL_DIR: every span AND flight-recorder event of the whole
+# run lands in ONE journal dir (tests re-root storage per test, which
+# would scatter-then-delete them);
 # CS230_METRICS_SNAPSHOT: conftest dumps the suite process's registry in
-# Prometheus text format at session end when the run failed.
+# Prometheus text format at session end when the run failed;
+# CS230_EVENTS_SNAPSHOT: conftest dumps the suite process's in-memory
+# flight-recorder ring (the scheduling decisions of the failed run) as
+# JSONL next to it.
 mkdir -p "$ART_DIR"
 rc=0
 if [ "$MODE" = "chaos" ]; then
   echo "== chaos/durability suite (JAX_PLATFORMS=cpu, -m slow) =="
   CS230_JOURNAL_DIR="$ART_DIR/journal" \
   CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
   JAX_PLATFORMS=cpu python -m pytest \
     tests/test_chaos.py tests/test_chaos_spmd.py tests/test_cluster.py \
     tests/test_durability.py tests/test_fault_tolerance.py \
@@ -49,6 +55,7 @@ else
   echo "== tier-1 fast suite (JAX_PLATFORMS=cpu, -m 'not slow') =="
   CS230_JOURNAL_DIR="$ART_DIR/journal" \
   CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || rc=$?
 fi

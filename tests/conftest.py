@@ -52,17 +52,35 @@ def eight_device_mesh():
 
 def pytest_sessionfinish(session, exitstatus):
     """CI forensics (deploy/ci.sh): on a red run, snapshot this process's
-    metrics registry in Prometheus text format so the failed suite's
-    counters/histograms ride the workflow artifact next to the span
-    journal (which CS230_JOURNAL_DIR already collects)."""
-    path = os.environ.get("CS230_METRICS_SNAPSHOT")
-    if not path or exitstatus == 0:
+    metrics registry in Prometheus text format AND the flight recorder's
+    event ring as JSONL, so the failed suite's counters/histograms and
+    scheduling decisions ride the workflow artifact next to the span/event
+    journals (which CS230_JOURNAL_DIR already collects file-side)."""
+    if exitstatus == 0:
         return
-    try:
-        from cs230_distributed_machine_learning_tpu.obs import render_prometheus
+    path = os.environ.get("CS230_METRICS_SNAPSHOT")
+    if path:
+        try:
+            from cs230_distributed_machine_learning_tpu.obs import (
+                render_prometheus,
+            )
 
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(path, "w") as f:
-            f.write(render_prometheus())
-    except Exception:  # noqa: BLE001 — forensics must not mask the failure
-        pass
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(render_prometheus())
+        except Exception:  # noqa: BLE001 — forensics must not mask the failure
+            pass
+    path = os.environ.get("CS230_EVENTS_SNAPSHOT")
+    if path:
+        try:
+            import json
+
+            from cs230_distributed_machine_learning_tpu.obs import RECORDER
+
+            events, _ = RECORDER.events(since=0, limit=10 ** 9)
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            with open(path, "w") as f:
+                for e in events:
+                    f.write(json.dumps(e, default=str) + "\n")
+        except Exception:  # noqa: BLE001 — forensics must not mask the failure
+            pass

@@ -16,7 +16,7 @@ from sklearn.linear_model import LogisticRegression
 from sklearn.model_selection import GridSearchCV
 
 from cs230_distributed_machine_learning_tpu import MLTaskManager
-from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+from cs230_distributed_machine_learning_tpu.obs import RECORDER, REGISTRY
 from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
 from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
 from cs230_distributed_machine_learning_tpu.runtime.executor import (
@@ -464,6 +464,37 @@ def test_hung_worker_lease_reclaim_job_completes_on_survivor(ft_cfg):
         # the hung worker was never declared dead — it is still registered
         assert hung_wid in cluster.engine.worker_snapshot()
         assert _counter("tpuml_subtasks_retried_total", reason="lease") > before
+
+        # ---- flight-recorder acceptance: the reclaim chain must be fully
+        # reconstructable from /explain (docs/OBSERVABILITY.md) ----
+        jid = submit["job_id"]
+        reclaims = [
+            e for e in RECORDER.events(limit=10 ** 6)[0]
+            if e["kind"] == "lease.reclaim" and e["job_id"] == jid
+        ]
+        assert reclaims, "no lease.reclaim event recorded"
+        stid = reclaims[0]["subtask_id"]
+        timeline = coord.explain(jid, stid)["events"]
+        kinds = [e["kind"] for e in timeline]
+        # placed on the hung worker -> leased -> reclaimed -> re-attempted
+        # (reason=lease) -> re-placed -> completed
+        assert kinds.count("placement") >= 2
+        assert "lease.grant" in kinds and "lease.reclaim" in kinds
+        assert any(
+            e["kind"] == "attempt" and e["data"]["reason"] == "lease"
+            for e in timeline
+        )
+        placements = [e for e in timeline if e["kind"] == "placement"]
+        for p in placements:
+            assert p["data"]["est_runtime_s"] > 0
+            assert p["data"]["candidates"], "score breakdown missing"
+        # the re-placement after the reclaim knew to avoid the hung worker
+        assert hung_wid in placements[-1]["data"]["excluded"]
+        results = [e for e in timeline if e["kind"] == "result"]
+        assert results and results[-1]["data"]["status"] == "completed"
+        # predictor calibration is non-empty after real feedback
+        report = cluster.engine.predictor.calibration_report()
+        assert report and all(v["n"] >= 1 for v in report.values())
     finally:
         cluster.shutdown()
 
@@ -514,6 +545,15 @@ def test_failing_worker_retries_complete_on_survivor(ft_cfg):
         _assert_clean_results(status, 4)
         assert status["job_result"]["failed"] == []
         assert _counter("tpuml_subtasks_retried_total", reason="failure") > before
+        # the flight recorder carries each retry decision with its inputs
+        retries = [
+            e for e in RECORDER.events(limit=10 ** 6)[0]
+            if e["kind"] == "retry" and e["job_id"] == m.job_id
+        ]
+        assert retries
+        assert all(e["data"]["reason"] == "failure" for e in retries)
+        assert all(e["data"]["backoff_s"] > 0 for e in retries)
+        assert all(e["worker_id"] is not None for e in retries)
     finally:
         cluster.shutdown()
 
@@ -549,6 +589,13 @@ def test_always_failing_subtask_quarantined_with_partial_status(ft_cfg):
         # degradation rides the progress/SSE schema too
         progress = coord.store.job_progress(sid, submit["job_id"])
         assert progress["tasks_failed"] == 1
+        # the quarantine verdict is on the subtask's explain timeline
+        stid = report[0]["subtask_id"]
+        timeline = coord.explain(submit["job_id"], stid)["events"]
+        quarantine = [e for e in timeline if e["kind"] == "quarantine"]
+        assert quarantine
+        assert quarantine[0]["data"]["reason"] == "retries_exhausted"
+        assert quarantine[0]["data"]["attempts"] == 2
     finally:
         cluster.shutdown()
 
@@ -623,5 +670,18 @@ def test_straggler_speculation_wins_no_duplicate_rows(ft_cfg):
         _assert_clean_results(status, 4)
         assert _counter("tpuml_speculative_launched_total") > before_launched
         assert _counter("tpuml_speculative_won_total") > before_won
+        # speculation is on the flight record: a launch naming the slow
+        # owner, and the win for the same subtask
+        events = [
+            e for e in RECORDER.events(limit=10 ** 6)[0]
+            if e["job_id"] == m.job_id
+        ]
+        launches = [e for e in events if e["kind"] == "speculate.launch"]
+        assert launches and launches[0]["worker_id"] == slow_wid
+        wins = [e for e in events if e["kind"] == "speculate.win"]
+        assert any(
+            w["subtask_id"] == l["subtask_id"]
+            for w in wins for l in launches
+        )
     finally:
         cluster.shutdown()

@@ -1,0 +1,410 @@
+"""Flight recorder + explainability layer (docs/OBSERVABILITY.md):
+recorder bounds/valve/journal rotation, the embedded time-series ring,
+predictor calibration, placement score breakdowns, the /explain //events/
+/metrics/history//predictor/calibration endpoints, dashboard rendering,
+the metrics-catalog parity gate, and the client explain() round trip
+against a live server."""
+
+import json
+import os
+import re
+import threading
+import time
+
+import pytest
+import requests
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.obs import (
+    RECORDER,
+    REGISTRY,
+    FlightRecorder,
+    MetricsRegistry,
+    TimeSeriesStore,
+    timeseries_sample,
+)
+from cs230_distributed_machine_learning_tpu.obs.tracing import Tracer, span, use_tracer
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.predictor import RuntimePredictor
+from cs230_distributed_machine_learning_tpu.runtime.scheduler import PlacementEngine
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+
+
+# ---------------- recorder ----------------
+
+
+def test_recorder_timeline_and_firehose():
+    rec = FlightRecorder(journal=False)
+    rec.record("placement", job_id="j", subtask_id="s", worker_id="w",
+               attempt=0, est_runtime_s=1.0)
+    rec.record("result", job_id="j", subtask_id="s", status="completed")
+    rec.record("worker.dead", worker_id="w")  # no subtask: firehose only
+    timeline = rec.timeline("j", "s")
+    assert [e["kind"] for e in timeline] == ["placement", "result"]
+    assert timeline[0]["data"]["est_runtime_s"] == 1.0
+    assert rec.timeline("j", "nope") is None
+    assert rec.job_subtasks("j") == ["s"]
+    events, last = rec.events()
+    assert [e["kind"] for e in events] == ["placement", "result", "worker.dead"]
+    assert last == 3
+    newer, _ = rec.events(since=2)
+    assert [e["kind"] for e in newer] == ["worker.dead"]
+    # truncation: the cursor is the last RETURNED seq, so a poller
+    # resuming from it picks up the remainder instead of skipping it
+    limited, cursor = rec.events(limit=1)
+    assert len(limited) == 1 and cursor == limited[-1]["seq"] == 1
+    rest, cursor2 = rec.events(since=cursor)
+    assert [e["seq"] for e in rest] == [2, 3] and cursor2 == 3
+
+
+def test_recorder_bounded_eviction():
+    rec = FlightRecorder(journal=False, max_events=4, max_subtasks=2)
+    for i in range(6):
+        rec.record("e", job_id="j", subtask_id=f"s{i}")
+    events, last = rec.events()
+    assert len(events) == 4 and last == 6  # ring evicted, seq monotonic
+    # oldest timelines evicted wholesale
+    assert rec.job_subtasks("j") == ["s4", "s5"]
+    assert rec.timeline("j", "s0") is None
+
+
+def test_recorder_valve_is_noop(monkeypatch):
+    monkeypatch.setenv("CS230_OBS", "0")
+    rec = FlightRecorder(journal=False)
+    assert rec.record("placement", job_id="j", subtask_id="s") is None
+    assert rec.timeline("j", "s") is None
+    assert rec.events() == ([], 0)
+
+
+def test_event_journal_writes_and_rotates_by_size(tmp_path, monkeypatch):
+    journal = tmp_path / "journal"
+    monkeypatch.setenv("CS230_JOURNAL_DIR", str(journal))
+    monkeypatch.setenv("CS230_JOURNAL_MAX_MB", "0.0002")  # 200 bytes
+    rec = FlightRecorder(journal=True)
+    for i in range(10):
+        rec.record("e", job_id="jr", subtask_id=f"s{i}", pad="x" * 80)
+    path = journal / "events.jsonl"
+    assert path.exists()
+    lines = [json.loads(l) for l in open(path) if l.strip()]
+    assert all(e["kind"] == "e" for e in lines)
+    # the cap (200 B) is far below 10 events x ~180 B: a rotation happened
+    # and the live file stayed bounded near the cap
+    assert (journal / "events.jsonl.1").exists()
+    assert path.stat().st_size < 1000
+
+
+def test_span_journal_rotates_by_size(tmp_path, monkeypatch):
+    journal = tmp_path / "journal"
+    monkeypatch.setenv("CS230_JOURNAL_DIR", str(journal))
+    monkeypatch.setenv("CS230_JOURNAL_MAX_MB", "0.0002")
+    t = Tracer(journal=True)
+    with use_tracer(t):
+        for i in range(10):
+            with span("rotated", trace_id=f"rot{i:013d}", tracer=t,
+                      pad="x" * 80):
+                pass
+    assert (journal / "spans.jsonl").exists()
+    assert (journal / "spans.jsonl.1").exists()
+
+
+# ---------------- embedded time series ----------------
+
+
+def test_timeseries_samples_counters_and_gauges():
+    reg = MetricsRegistry()
+    c = reg.counter("ts_demo_total")
+    c.inc(5)
+    g = reg.gauge("ts_demo_gauge")
+    g.set(1.5, wid="w0")
+    store = TimeSeriesStore(min_interval_s=0.0)
+    assert store.sample(reg, now=100.0, force=True) > 0
+    c.inc(3)
+    store.sample(reg, now=110.0, force=True)
+    (series,) = store.history("ts_demo_total")
+    assert series["labels"] == {}
+    assert series["samples"] == [[100.0, 5.0], [110.0, 8.0]]
+    (gseries,) = store.history("ts_demo_gauge")
+    assert gseries["labels"] == {"wid": "w0"}
+    assert store.history("nope") == []
+    # ?since= trims old samples
+    (trimmed,) = store.history("ts_demo_total", since=105.0)
+    assert trimmed["samples"] == [[110.0, 8.0]]
+    assert "ts_demo_total" in store.names()
+
+
+def test_timeseries_throttle_and_bounds():
+    reg = MetricsRegistry()
+    reg.counter("tb_total").inc()
+    store = TimeSeriesStore(min_interval_s=3600.0, max_samples=3)
+    assert store.sample(reg) > 0
+    assert store.sample(reg) == 0  # throttled
+    assert store.sample(reg, force=True) > 0  # bypass
+    for i in range(5):
+        store.sample(reg, now=float(i), force=True)
+    (series,) = store.history("tb_total")
+    assert len(series["samples"]) == 3  # ring bound
+
+
+def test_timeseries_valve_is_noop(monkeypatch):
+    monkeypatch.setenv("CS230_OBS", "0")
+    reg = MetricsRegistry()
+    reg.counter("tv_total").inc()
+    store = TimeSeriesStore(min_interval_s=0.0)
+    assert store.sample(reg, force=True) == 0
+    assert store.history("tv_total") == []
+
+
+# ---------------- predictor calibration ----------------
+
+
+def test_predictor_calibration_report():
+    p = RuntimePredictor()
+    for _ in range(4):
+        p.record_calibration("LogReg", 2.0, 1.0)
+    fam = p.calibration_report()["LogReg"]
+    assert fam["n"] == 4
+    assert fam["ratio_median"] == pytest.approx(2.0)
+    assert fam["ratio_ewma"] == pytest.approx(2.0)
+    assert fam["abs_rel_error_mean"] == pytest.approx(1.0)
+    assert fam["last_predicted_s"] == 2.0 and fam["last_actual_s"] == 1.0
+    # invalid pairs (cold predictor, zero estimates) are ignored
+    p.record_calibration("LogReg", 0.0, 1.0)
+    p.record_calibration("LogReg", 1.0, 0.0)
+    assert p.calibration_report()["LogReg"]["n"] == 4
+    # the metric families fed too
+    assert (
+        REGISTRY.histogram("tpuml_predictor_abs_rel_error").count(model="LogReg")
+        >= 4
+    )
+    assert (
+        REGISTRY.gauge("tpuml_predictor_calibration_ratio").value(model="LogReg")
+        == pytest.approx(2.0)
+    )
+
+
+def test_calibration_surface_tolerates_stub_predictors():
+    """Stub predictors subclassing RuntimePredictor without __init__
+    (the engine-test pattern) must yield an empty report, not an
+    AttributeError 500 from /predictor/calibration."""
+
+    class Stub(RuntimePredictor):
+        def __init__(self):
+            pass
+
+    stub = Stub()
+    stub.record_calibration("X", 1.0, 1.0)  # silently skipped
+    assert stub.calibration_report() == {}
+
+
+def test_scheduler_feedback_feeds_calibration():
+    eng = PlacementEngine()
+    wid = eng.subscribe()
+    eng.place({"subtask_id": "cal-s1", "job_id": "cal-j1",
+               "model_type": "LogisticRegression", "mem_estimate_mb": 1.0})
+    now = time.time()
+    eng.on_metrics({"worker_id": wid, "subtask_id": "cal-s1",
+                    "algo": "LogisticRegression",
+                    "started_at": now - 0.5, "finished_at": now})
+    rep = eng.predictor.calibration_report()
+    assert rep["LogisticRegression"]["n"] == 1
+    # the pair is the AS-USED estimate vs the observed wall
+    assert rep["LogisticRegression"]["last_actual_s"] == pytest.approx(0.5, rel=0.1)
+
+
+# ---------------- placement explainability ----------------
+
+
+def test_place_records_score_breakdown_and_lease():
+    eng = PlacementEngine()
+    w0 = eng.subscribe()
+    w1 = eng.subscribe()
+    task = {"subtask_id": "fb-s1", "job_id": "fb-j1",
+            "model_type": "LogisticRegression", "mem_estimate_mb": 1.0,
+            "excluded_workers": [w0]}
+    chosen = eng.place(task)
+    assert chosen == w1  # exclusion honored while a non-excluded peer lives
+    timeline = RECORDER.timeline("fb-j1", "fb-s1")
+    assert timeline is not None
+    kinds = [e["kind"] for e in timeline]
+    assert kinds == ["placement", "lease.grant"]
+    placement = timeline[0]
+    assert placement["worker_id"] == w1
+    d = placement["data"]
+    assert d["excluded"] == [w0] and d["excluded_overridden"] is False
+    assert d["est_runtime_s"] > 0 and d["n_workers"] == 2
+    assert d["chosen_score"] == pytest.approx(d["candidates"][0]["score"])
+    for cand in d["candidates"]:
+        assert {"worker_id", "score", "effective_finish_time_s",
+                "est_over_speed_s", "speed_factor", "load_seconds",
+                "queue_depth", "penalty_s", "breaker_state"} <= set(cand)
+    lease = timeline[1]
+    assert lease["data"]["deadline_ts"] > time.time()
+    assert lease["data"]["lease_s"] >= eng.cfg.lease_floor_s
+
+
+def test_disabled_valve_records_no_placement(monkeypatch):
+    monkeypatch.setenv("CS230_OBS", "0")
+    before = RECORDER.last_seq()
+    eng = PlacementEngine()
+    eng.subscribe()
+    eng.place({"subtask_id": "off-s1", "job_id": "off-j1",
+               "model_type": "LogisticRegression", "mem_estimate_mb": 1.0})
+    assert RECORDER.last_seq() == before
+    assert RECORDER.timeline("off-j1", "off-s1") is None
+
+
+# ---------------- metrics-catalog parity ----------------
+
+
+def test_metric_catalog_documented():
+    """Every tpuml_* family in the registry must appear (full name) in
+    docs/OBSERVABILITY.md's catalog — the catalog has drifted twice."""
+    doc_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "OBSERVABILITY.md"
+    )
+    documented = set(re.findall(r"tpuml_[a-z0-9_]+", open(doc_path).read()))
+    missing = [
+        name for name in REGISTRY.names()
+        if name.startswith("tpuml_") and name not in documented
+    ]
+    assert not missing, (
+        f"metrics registered but undocumented in docs/OBSERVABILITY.md: "
+        f"{missing}"
+    )
+
+
+# ---------------- REST endpoints (direct-mode coordinator) ----------------
+
+
+@pytest.fixture()
+def client():
+    from werkzeug.test import Client
+
+    return Client(create_app(Coordinator()))
+
+
+def test_dashboard_renders_with_all_panels(client):
+    resp = client.get("/dashboard")
+    assert resp.status_code == 200
+    assert resp.mimetype == "text/html"
+    html = resp.get_data(as_text=True)
+    for panel in ("Jobs", "Latest job trace", "Latest job cost",
+                  "Metrics history", "Flight recorder", "Workers",
+                  "Queues", "Supervised agents"):
+        assert panel in html, f"dashboard panel {panel!r} missing"
+    # every JSON feed the dashboard polls must answer on a fresh,
+    # empty-state coordinator (no 500s)
+    for path in ("/jobs", "/workers", "/queues", "/supervisor", "/events",
+                 "/metrics/history", "/predictor/calibration"):
+        assert client.get(path).status_code == 200, path
+
+
+def test_explain_unknown_subtask_is_404_not_traceback(client):
+    resp = client.get("/explain/no-such-job/no-such-subtask")
+    assert resp.status_code == 404
+    body = resp.get_json()
+    assert body["status"] == "error"
+    assert "no recorded events" in body["message"]
+    assert client.get("/explain/no-such-job").status_code == 404
+
+
+def test_events_endpoint_serves_firehose_with_cursor(client):
+    RECORDER.record("test.marker", job_id="ev-j", subtask_id="ev-s", n=1)
+    body = client.get("/events").get_json()
+    assert body["last_seq"] >= 1
+    assert any(e["kind"] == "test.marker" for e in body["events"])
+    # cursor semantics: nothing newer than last_seq
+    again = client.get(f"/events?since={body['last_seq']}").get_json()
+    assert again["events"] == [] and again["n_events"] == 0
+
+
+def test_metrics_history_endpoint(client):
+    REGISTRY.counter("tpuml_jobs_submitted_total").inc(0)  # ensure a cell
+    timeseries_sample(force=True)
+    names = client.get("/metrics/history").get_json()["names"]
+    assert "tpuml_jobs_submitted_total" in names
+    body = client.get(
+        "/metrics/history",
+        query_string={"name": "tpuml_jobs_submitted_total"},
+    ).get_json()
+    assert body["name"] == "tpuml_jobs_submitted_total"
+    assert body["series"] and body["series"][0]["samples"]
+    empty = client.get(
+        "/metrics/history", query_string={"name": "tpuml_nope"}
+    ).get_json()
+    assert empty["series"] == []
+
+
+def test_predictor_calibration_empty_in_direct_mode(client):
+    body = client.get("/predictor/calibration").get_json()
+    assert body == {"families": {}, "n_families": 0}
+
+
+# ---------------- live-server round trip (cluster mode) ----------------
+
+
+@pytest.fixture()
+def http_cluster():
+    from werkzeug.serving import make_server
+
+    from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+    get_config().scheduler.heartbeat_interval_s = 0.1
+    cluster = ClusterRuntime()
+    cluster.add_executor()
+    coord = Coordinator(cluster=cluster)
+    app = create_app(coord)
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield coord, f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+    cluster.shutdown()
+
+
+def test_manager_explain_round_trip_against_live_server(http_cluster):
+    coord, url = http_cluster
+    m = MLTaskManager(url=url)
+    status = m.train(
+        GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.1, 1.0]}, cv=3),
+        "iris", show_progress=False, timeout=120,
+    )
+    assert status["job_status"] == "completed"
+    jid = m.job_id
+    # timeline discovery, then the client helper parses one timeline
+    listing = requests.get(f"{url}/explain/{jid}", timeout=10).json()
+    assert listing["subtask_ids"]
+    stid = listing["subtask_ids"][0]
+    timeline = m.explain(subtask_id=stid)  # job_id defaults to the train()
+    assert timeline["job_id"] == jid and timeline["subtask_id"] == stid
+    kinds = [e["kind"] for e in timeline["events"]]
+    assert "placement" in kinds and "result" in kinds
+    placement = next(e for e in timeline["events"] if e["kind"] == "placement")
+    assert placement["data"]["candidates"], "score breakdown missing"
+    result = next(e for e in timeline["events"] if e["kind"] == "result")
+    assert result["data"]["status"] == "completed"
+    # unknown subtask: KeyError client-side, 404 on the wire
+    with pytest.raises(KeyError):
+        m.explain(jid, "no-such-subtask")
+    # calibration populated once the metrics feedback landed
+    deadline = time.time() + 10
+    cal = {}
+    while time.time() < deadline:
+        cal = requests.get(f"{url}/predictor/calibration", timeout=10).json()
+        if cal.get("n_families"):
+            break
+        time.sleep(0.1)
+    assert cal["families"]["LogisticRegression"]["n"] >= 1
+    # the scrape drives the embedded time series (>= 2 samples for a
+    # counter that moved during the run)
+    requests.get(f"{url}/metrics/prom", timeout=10)
+    time.sleep(1.1)  # the sampler's min interval
+    requests.get(f"{url}/metrics/prom", timeout=10)
+    hist = requests.get(
+        f"{url}/metrics/history",
+        params={"name": "tpuml_subtasks_dispatched_total"}, timeout=10,
+    ).json()
+    assert sum(len(s["samples"]) for s in hist["series"]) >= 2
