@@ -4,7 +4,10 @@ fold-lanes vmapped) on the real device.
 
 The r4 finding was that the fit is bound by W-proportional terms, not
 histogram MACs (BASELINE.md "Grouped histograms"); this harness pins WHICH
-term so the r5 attack goes to the right place.
+term so the r5 attack goes to the right place. PR 6 adds the alternative
+histogram kernels (``histscatter``: the bin-and-scatter segment-sum form;
+``histpallas``: the fused Pallas kernel, interpreter off-TPU) so the
+one-hot matmul baseline and its replacements are A/B-able on any backend.
 
 Measurement: per-dispatch overhead on the tunneled device is ~70-100 ms
 (and block_until_ready is a no-op), so each component runs ITERS times
@@ -13,10 +16,12 @@ loop-invariant hoisting), synced by a scalar fetch, and reports
 (total - overhead) / ITERS.
 
 Usage: python benchmarks/deep_profile.py  [PROF_W=1024 PROF_LANES=6]
+       [PROF_N=0 (row subsample, 0=all) PROF_OUT=path.json]
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -34,11 +39,22 @@ W = int(os.environ.get("PROF_W", 1024))
 LANES = int(os.environ.get("PROF_LANES", 6))
 ITERS = int(os.environ.get("PROF_ITERS", 5))
 REPS = int(os.environ.get("PROF_REPS", 3))
-#: comma-list of component keys to run (default all): hist,route,route2,
-#: gain,topk,topk2,leaf
+#: row subsample for CPU-feasible runs (0 = full dataset)
+SUB_N = int(os.environ.get("PROF_N", 0))
+#: when set, component timings land in this JSON (ms per level/op)
+OUT = os.environ.get("PROF_OUT", "")
+#: comma-list of component keys to run (default all): hist,histscatter,
+#: histpallas,histc,route,route2,pieces,gain,topk,topk2,leaf
 ONLY = set(
     k for k in os.environ.get("PROF_ONLY", "").split(",") if k
 )
+
+RESULTS = {}
+
+
+def record(key, label, t_ms):
+    RESULTS[key] = round(t_ms, 3)
+    print(f"{label:38s}{t_ms:8.1f} ms")
 
 
 def want(key):
@@ -78,6 +94,11 @@ def main():
     data = cache.get("covertype", "classification")
     X = np.asarray(data.X, np.float32)
     y = np.asarray(data.y, np.int32)
+    if SUB_N and SUB_N < len(X):
+        # CPU-feasible subsample: the one-hot matmul baseline is O(n*W*kk*
+        # d*nb) MACs — intractable at the full shape without an MXU
+        sub = np.random.RandomState(0).permutation(len(X))[:SUB_N]
+        X, y = X[sub], y[sub]
     n, d = X.shape
     print(f"covertype {n}x{d}, W={W}, lanes={LANES}, iters={ITERS}", flush=True)
 
@@ -99,8 +120,11 @@ def main():
     do_split = jnp.asarray(rng.rand(LANES, W) < 0.8)
     left_id = jnp.asarray(rng.randint(0, A_CAP, size=(LANES, W)).astype(np.int32))
 
-    # ---- 1. level histogram (s8 path, as the classification fit runs) ----
+    # ---- 1. level histogram (s8 path, as the classification fit ran it
+    # pre-PR-6: the one-hot matmul baseline) ----
     if want("hist"):
+        os.environ["CS230_HIST_KERNEL"] = "matmul"
+
         def hist_step(i, acc):
             loc = (local0 + i) % W  # iteration-dependent: no hoisting
             H = jax.vmap(
@@ -109,7 +133,47 @@ def main():
             return acc + H.sum()  # full reduce keeps every cell live
 
         t = timed_loop(hist_step, jnp.zeros(()))
-        print(f"hist s8 one-hot (W={W}):              {t*1e3:8.1f} ms/level")
+        os.environ.pop("CS230_HIST_KERNEL", None)
+        record("hist_matmul_ms_per_level", f"hist s8 one-hot (W={W}):", t * 1e3)
+
+    # ---- 1s. bin-and-scatter level histogram (ops/pallas_hist.py,
+    # the CS230_HIST_KERNEL=scatter / CPU-auto form) ----
+    if want("histscatter"):
+        from cs230_distributed_machine_learning_tpu.ops.pallas_hist import (
+            level_histogram_scatter as _scatter,
+        )
+
+        def hist_scatter_step(i, acc):
+            loc = (local0 + i) % W
+            H = jax.vmap(lambda l, sc: _scatter(l, xb_d, sc, W, NB))(loc, SC)
+            return acc + H.sum()
+
+        t = timed_loop(hist_scatter_step, jnp.zeros(()))
+        record("hist_scatter_ms_per_level", f"hist bin-and-scatter (W={W}):", t * 1e3)
+
+    # ---- 1p. fused Pallas level histogram (compiled on TPU; off-TPU this
+    # times the INTERPRETER — functional coverage only, not a perf number) ----
+    if want("histpallas"):
+        from cs230_distributed_machine_learning_tpu.ops.pallas_hist import (
+            level_histogram_pallas as _pallas,
+        )
+
+        interp = jax.default_backend() != "tpu"
+
+        def hist_pallas_step(i, acc):
+            loc = (local0 + i) % W
+            H = jax.vmap(
+                lambda l, sc: _pallas(
+                    l, xb_d, sc, W, NB, integer_stats=True, interpret=interp)
+            )(loc, SC)
+            return acc + H.sum()
+
+        t = timed_loop(hist_pallas_step, jnp.zeros(()))
+        record(
+            "hist_pallas_ms_per_level"
+            + ("_INTERPRET" if interp else ""),
+            f"hist Pallas fused (W={W}):", t * 1e3,
+        )
 
     # ---- 1b. COMPACT level histogram (sorted-rows block form) ----
     if want("histc"):
@@ -124,7 +188,7 @@ def main():
             return acc + H.sum()
 
         t = timed_loop(histc_step, jnp.zeros(()))
-        print(f"hist COMPACT (R={T._COMPACT_R}, M={T._COMPACT_M}):   {t*1e3:8.1f} ms/level")
+        record("hist_compact_ms_per_level", f"hist COMPACT (R={T._COMPACT_R}, M={T._COMPACT_M}):", t * 1e3)
 
     # ---- 2c. routing primitive costs (searchsorted / row gathers) ----
     if want("pieces"):
@@ -135,7 +199,7 @@ def main():
             return (node + out % 3) % A_CAP
 
         t = timed_loop(ss_step, node0)
-        print(f"searchsorted [n] in [W]:              {t*1e3:8.1f} ms")
+        record("searchsorted_ms", "searchsorted [n] in [W]:", t * 1e3)
 
         def gather_small_step(i, node):
             out = jax.vmap(lambda nd, tb: tb[jnp.minimum(nd, W - 1)])(
@@ -144,7 +208,7 @@ def main():
             return (node + out) % A_CAP
 
         t = timed_loop(gather_small_step, node0)
-        print(f"row gather [n] from [W] table:        {t*1e3:8.1f} ms")
+        record("row_gather_table_ms", "row gather [n] from [W] table:", t * 1e3)
 
         def gather_xb_step(i, node):
             f_i = jnp.minimum(node, d - 1)
@@ -154,14 +218,14 @@ def main():
             return (node + out + i) % A_CAP
 
         t = timed_loop(gather_xb_step, node0)
-        print(f"row gather xb[row, f_row]:            {t*1e3:8.1f} ms")
+        record("row_gather_xb_ms", "row gather xb[row, f_row]:", t * 1e3)
 
         def sort_step(i, node):
             s = jnp.sort((node + i) % A_CAP, axis=1)
             return s
 
         t = timed_loop(sort_step, node0)
-        print(f"sort [lanes, n] keys:                 {t*1e3:8.1f} ms")
+        record("sort_keys_ms", "sort [lanes, n] keys:", t * 1e3)
 
     # ---- 2. routing block (one-hot masks, as build_tree_deep) ----
     if want("route"):
@@ -182,7 +246,7 @@ def main():
             return out % A_CAP
 
         t = timed_loop(route_step, node0)
-        print(f"routing one-hot masks (W={W}):        {t*1e3:8.1f} ms/level")
+        record("route_onehot_ms_per_level", f"routing one-hot masks (W={W}):", t * 1e3)
 
     # ---- 2b. routing via sorted-frontier searchsorted + row gathers ----
     if want("route2"):
@@ -201,7 +265,7 @@ def main():
             return out % A_CAP
 
         t = timed_loop(route_gather_step, node0)
-        print(f"routing searchsorted+gather:          {t*1e3:8.1f} ms/level")
+        record("route_gather_ms_per_level", "routing searchsorted+gather:", t * 1e3)
 
     # shared candidate-stage inputs (blocks 3-4b). H0 is ~2 GB — generate
     # ON DEVICE (a host upload at the tunnel's ~9 MB/s would take minutes)
@@ -224,7 +288,7 @@ def main():
             return (acc + bg.sum() + bfx.sum() + bbx.sum(), H0)
 
         t = timed_loop(gain_step, (jnp.zeros(()), H0))
-        print(f"split gain + pick (2W cand):          {t*1e3:8.1f} ms/level")
+        record("gain_pick_ms_per_level", "split gain + pick (2W cand):", t * 1e3)
 
     # ---- 4. top_k W of 2W + candidate H gather ----
     if want("topk"):
@@ -240,7 +304,7 @@ def main():
             return (acc + vals.sum() + ids.sum() + Hs.sum(), H0)
 
         t = timed_loop(topk_step, (jnp.zeros(()), H0))
-        print(f"top_k {W} of {2*W} + H gather:        {t*1e3:8.1f} ms/level")
+        record("topk_gather_ms_per_level", f"top_k {W} of {2*W} + H gather:", t * 1e3)
 
     # ---- 4b. top_k alone ----
     if want("topk2"):
@@ -250,7 +314,7 @@ def main():
             return acc + vals.sum() + sel.sum()
 
         t = timed_loop(topk_only_step, jnp.zeros(()))
-        print(f"top_k {W} of {2*W} alone:             {t*1e3:8.1f} ms/level")
+        record("topk_only_ms_per_level", f"top_k {W} of {2*W} alone:", t * 1e3)
 
     # ---- 5. leaf segment_sum epilogue (once per tree, for scale) ----
     if want("leaf"):
@@ -262,7 +326,23 @@ def main():
             return acc + S.sum()
 
         t = timed_loop(leaf_step, jnp.zeros(()))
-        print(f"leaf segment_sum (per tree):          {t*1e3:8.1f} ms")
+        record("leaf_segment_sum_ms", "leaf segment_sum (per tree):", t * 1e3)
+
+    if OUT:
+        payload = {
+            "metric": "deep_tree_level_profile",
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "shape": {"n": n, "d": d, "W": W, "n_bins": NB, "kk": KK,
+                      "lanes": LANES},
+            "iters": ITERS,
+            "reps": REPS,
+            "components_ms": RESULTS,
+            "note": os.environ.get("PROF_NOTE", ""),
+        }
+        with open(OUT, "w") as f:
+            json.dump(payload, f, indent=1)
+        print(f"wrote {OUT}")
 
 
 if __name__ == "__main__":

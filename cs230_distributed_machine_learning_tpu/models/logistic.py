@@ -57,6 +57,14 @@ class LogisticRegressionKernel(ModelKernel):
     hyper_defaults = {"C": 1.0, "max_iter": 100.0, "tol": 1e-4}
     static_defaults = {"fit_intercept": True, "penalty": "l2"}
 
+    def trace_salt(self):
+        """CS230_MASKED_GRAD selects the masked-gradient formulation at
+        trace time (see ``_masked_grad_mode``) — it must key every
+        executable cache like the tree histogram knobs do. The salt
+        carries the RESOLVED mode, not the raw string: invalid/alias
+        values collapse to the same behavior and must share a cache key."""
+        return (_masked_grad_mode(),)
+
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         if static.get("penalty") not in ("l2", None, "none"):
             raise ValueError(
@@ -107,16 +115,14 @@ class LogisticRegressionKernel(ModelKernel):
         else:
             mm = jnp.matmul
 
-        def grad_fn(W):
-            P = jax.nn.softmax(mm(A, W), axis=-1)
-            G = C * mm(A.T, w[:, None] * (P - Y)) + lam * pen_mask * W
-            return G, P
-
+        mode = _masked_grad_mode()
         if static["_method"] == "newton":
             steps = int(static.get("_iters", _NEWTON_STEPS))
-            W = _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol, steps)
+            W = _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
+                        steps, fused=(mode != "legacy"))
         else:
             steps = int(static.get("_iters", _NESTEROV_STEPS))
+            grad_fn = _make_masked_grad_fn(A, Y, y, w, C, lam, pen_mask, mm, mode)
             W = _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps)
         return W
 
@@ -344,7 +350,88 @@ def _interpret_mode() -> bool:
     return os.environ.get("CS230_PALLAS_INTERPRET", "") == "1"
 
 
-def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol, steps=_NEWTON_STEPS):
+def _masked_grad_mode() -> str:
+    """Valve for the fused masked-gradient formulation (ISSUE 6 tentpole).
+
+    - ``auto`` (default): fused-mask XLA formulation everywhere; the fused
+      Pallas lane kernel for large-n nesterov fits on a real TPU backend.
+    - ``xla``: fused-mask XLA formulation only (never the lane kernel).
+    - ``pallas``: force the Pallas lane kernel (uses the interpreter off
+      TPU — combine with CS230_PALLAS_INTERPRET=1 in tests). Applies to
+      the grad-descent driver only: the ``_newton`` driver needs the
+      probabilities for its Hessian anyway, so it always runs the fused
+      XLA form (any non-``legacy`` mode).
+    - ``legacy``: the pre-fusion formulation (separate ``w*(P-Y)``
+      elementwise pass per iteration), kept for A/B and rollback.
+    """
+    mode = os.environ.get("CS230_MASKED_GRAD", "auto").lower()
+    return mode if mode in ("auto", "xla", "pallas", "legacy") else "auto"
+
+
+def _make_masked_grad_fn(A, Y, y, w, C, lam, pen_mask, mm, mode):
+    """Per-iteration masked-gradient closure for the grad-descent driver.
+
+    The fused formulations eliminate the measured fold-mask overhead
+    (benchmarks/LOGREG_PROFILE_MEASURED.json): the mask folds into the
+    softmax normalizer (``w * softmax(z) == exp(z - max) * (w / den)``)
+    and the masked label term ``w*Y`` is loop-invariant (hoisted out of
+    the solver scan), so a masked iteration runs at most the op count of
+    an unmasked one — no masked copy of A or of the probabilities is ever
+    materialized.
+    """
+    if mode == "legacy":
+        def grad_fn(W):
+            P = jax.nn.softmax(mm(A, W), axis=-1)
+            G = C * mm(A.T, w[:, None] * (P - Y)) + lam * pen_mask * W
+            return G, P
+        return grad_fn
+
+    n, dp = A.shape
+    c = Y.shape[1]
+    use_pallas = mode == "pallas" or (
+        mode == "auto"
+        and not _interpret_mode()
+        and jax.default_backend() == "tpu"
+        and n >= 4096
+    )
+    if use_pallas:
+        from ..ops.pallas_logreg import masked_softmax_grad
+
+        bm = 256
+        dpp = _ceil_to(dp, 128)
+        cp = _ceil_to(c, 128)
+        n_pad = _ceil_to(n, bm)
+        # loop-invariant paddings: staged once per fit, reused every step
+        Ab = jnp.pad(A.astype(jnp.float32), ((0, n_pad - n), (0, dpp - dp))).astype(
+            jnp.bfloat16
+        )
+        y2 = jnp.pad(y.astype(jnp.int32), (0, n_pad - n))[:, None]
+        wm = jnp.pad(w.astype(jnp.float32), (0, n_pad - n))[:, None]
+        interp = jax.default_backend() != "tpu"
+
+        def grad_fn(W):
+            Wp = jnp.pad(W, ((0, dpp - dp), (0, cp - c))).astype(jnp.bfloat16)
+            Gk = masked_softmax_grad(Ab, Wp, y2, wm, c=c, bm=bm, interpret=interp)
+            G = C * Gk[:dp, :c] + lam * pen_mask * W
+            return G, None
+        return grad_fn
+
+    WY = w[:, None] * Y  # loop-invariant: hoisted out of the solver scan
+
+    def grad_fn(W):
+        # w * softmax(Z) with the mask folded into the per-row normalizer:
+        # e * (w/den) — an [n,1] divide replacing softmax's [n,c] divide,
+        # so the masked iteration is never costlier than an unmasked one
+        Z = mm(A, W)
+        e = jnp.exp(Z - jnp.max(Z, axis=-1, keepdims=True))
+        scale = (w / jnp.sum(e, axis=-1))[:, None]
+        G = C * mm(A.T, e * scale - WY) + lam * pen_mask * W
+        return G, None
+    return grad_fn
+
+
+def _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
+            steps=_NEWTON_STEPS, fused=True):
     n, dp = A.shape
     c = Y.shape[1]
     dim = dp * c
@@ -358,19 +445,37 @@ def _newton(A, Y, w, W0, grad_fn, C, lam, pen_mask, max_iter, tol, steps=_NEWTON
         return C * nll + 0.5 * jnp.sum((lam * pen_mask) * W * W)
 
     alphas = jnp.asarray([1.0, 0.5, 0.25, 0.1, 0.02], jnp.float32)
+    # fused-mask restructuring: the masked label term wc*Y is loop-invariant
+    # (hoisted out of the scan) and the single masked product WP = wc*P is
+    # shared by the gradient AND both Hessian terms — the legacy per-step
+    # masked copies of A (``A*wc``) and of the residual are never built
+    WYc = (C * w)[:, None] * Y
+
+    def grad_and_P(W):
+        if not fused:
+            P = jax.nn.softmax(mm(A, W), axis=-1)
+            G = C * mm(A.T, w[:, None] * (P - Y)) + lam * pen_mask * W
+            WP = (w * C)[:, None] * P
+            return G, P, WP
+        P = jax.nn.softmax(mm(A, W), axis=-1)
+        WP = (w * C)[:, None] * P  # the one masked elementwise pass
+        G = mm(A.T, WP - WYc) + lam * pen_mask * W
+        return G, P, WP
 
     def step(carry, t):
         W, done = carry
-        G, P = grad_fn(W)
-        wc = w * C
+        G, P, WP = grad_and_P(W)
         # Hessian: H[(i,a),(j,b)] = sum_n wc_n A_ni A_nj (P_na δab − P_na P_nb)
-        # block-diagonal part: per class a, A' diag(wc * P_a) A
-        blocks = jnp.einsum("ni,na,nj->aij", A * wc[:, None], P, A)  # [c, dp, dp]
+        # block-diagonal part: per class a, A' diag(wc * P_a) A == A' diag(WP_a) A
+        blocks = jnp.einsum("ni,na,nj->aij", A, WP, A)  # [c, dp, dp]
         H = jnp.zeros((dp, c, dp, c), jnp.float32)
         H = H.at[:, jnp.arange(c), :, jnp.arange(c)].add(blocks)
-        # rank-correction part: U'WU with U[n, dp*c] = A_ni * P_na (one matmul)
+        # rank-correction part: U' UW with U[n, dp*c] = A_ni * P_na and
+        # UW = A_ni * WP_na (== (U * wc) without materializing a third
+        # masked copy beyond WP itself)
         U = (A[:, :, None] * P[:, None, :]).reshape(n, dim)
-        H = H.reshape(dim, dim) - U.T @ (U * wc[:, None])
+        UW = (A[:, :, None] * WP[:, None, :]).reshape(n, dim)
+        H = H.reshape(dim, dim) - U.T @ UW
         H = H + jnp.diag(pen_diag) + 1e-6 * jnp.eye(dim, dtype=jnp.float32)
         delta = jnp.linalg.solve(H, G.reshape(-1)).reshape(dp, c)
         # ill-conditioned solves (high C, saturated P, f32) can yield

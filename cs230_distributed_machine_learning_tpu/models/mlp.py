@@ -34,6 +34,28 @@ def _interpret_mode() -> bool:
     return os.environ.get("CS230_PALLAS_INTERPRET", "") == "1"
 
 
+def _v_dtype_mode() -> str:
+    """Storage dtype of the generic path's SECOND Adam moment:
+    ``bf16`` (default — stochastic rounding, halves the dominant Adam-state
+    HBM term) or ``f32`` (the pre-PR-6 layout, for A/B and rollback)."""
+    mode = os.environ.get("CS230_MLP_V_DTYPE", "bf16").lower()
+    return mode if mode in ("bf16", "f32") else "bf16"
+
+
+def _sr_bf16(x32, key):
+    """Stochastically round f32 -> bf16: add uniform bits below the bf16
+    mantissa boundary, then truncate. Unbiased (E[q(x)] == x), so EMA
+    updates smaller than bf16's round-to-nearest deadband accumulate in
+    expectation instead of freezing — the property that makes a bf16
+    second Adam moment safe (beta2=0.999 updates are ~0.1% of v, under
+    the ~0.4% deadband). Inputs are non-negative finite EMAs; the add may
+    carry into the exponent, which is exactly round-up."""
+    u = jax.lax.bitcast_convert_type(x32.astype(jnp.float32), jnp.uint32)
+    r = jax.random.bits(key, x32.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    u = (u + r) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(u, jnp.float32).astype(jnp.bfloat16)
+
+
 def _act(name: str):
     return {
         "relu": jax.nn.relu,
@@ -74,7 +96,10 @@ class _MLPBase(ModelKernel):
         salt carries the EFFECTIVE boolean, not the raw string: only the
         exact value "1" changes pick_k, so "0"/"yes"/unset must share one
         cache key (a raw-string salt would force spurious retraces)."""
-        return ("1" if os.environ.get("CS230_MLP_K16") == "1" else "",)
+        return (
+            "1" if os.environ.get("CS230_MLP_K16") == "1" else "",
+            _v_dtype_mode(),
+        )
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         hls = static.get("hidden_layer_sizes", (100,))
@@ -130,7 +155,10 @@ class _MLPBase(ModelKernel):
         dims = self._dims(d, static)
         wparams = sum(a * b + b for a, b in zip(dims[:-1], dims[1:]))
         bs = int(static.get("_bs", 200))
-        state_mb = 3.0 * wparams * 4 / 1e6  # params + m + v (v f32, m bf16)
+        # params (f32) + m + v (both bf16 by default; CS230_MLP_V_DTYPE=f32
+        # widens v back to the pre-PR-6 layout)
+        v_bytes = 2 if _v_dtype_mode() == "bf16" else 4
+        state_mb = wparams * (4 + 2 + v_bytes) / 1e6
         act_mb = 3.0 * bs * sum(dims) * 4 / 1e6  # fwd+bwd live activations
         return max(1.0, state_mb + act_mb + 1.0)
 
@@ -174,15 +202,19 @@ class _MLPBase(ModelKernel):
         target = self._target(y, static)
 
         # bf16 matmuls (f32 accumulation) for the fwd/bwd passes — the MXU's
-        # native mode; and a bf16 FIRST moment. The fit is Adam-STATE-
-        # bandwidth bound, not compute bound (params+m+v stream from HBM
-        # every step while each step's matmul touches only batch_size rows),
-        # so shrinking moment bytes matters more than the matmul rate.
-        # The second moment v MUST stay f32: beta2=0.999 makes per-step
-        # updates ~0.1% of v, below bf16's ~0.4% round-to-nearest deadband —
-        # a bf16 v freezes at stale values and silently suppresses the
-        # effective step size (m's beta1=0.9 steps are ~25x the deadband,
-        # safe in bf16).
+        # native mode; and bf16 moments. The fit is Adam-STATE-bandwidth
+        # bound, not compute bound (params+m+v stream from HBM every step
+        # while each step's matmul touches only batch_size rows), so
+        # shrinking moment bytes matters more than the matmul rate.
+        # The second moment needs care: beta2=0.999 makes per-step updates
+        # ~0.1% of v, below bf16's ~0.4% round-to-nearest deadband — a
+        # nearest-rounded bf16 v freezes at stale values and silently
+        # suppresses the effective step size (m's beta1=0.9 steps are ~25x
+        # the deadband, safe with nearest rounding). A bf16 v is therefore
+        # stored with STOCHASTIC rounding: the quantizer is unbiased, so
+        # sub-deadband updates land in expectation instead of vanishing
+        # (convergence-parity vs the f32 v pinned in tests/test_mlp.py;
+        # CS230_MLP_V_DTYPE=f32 restores the old state layout).
         def mm(a, b):
             return jnp.matmul(
                 a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
@@ -201,8 +233,12 @@ class _MLPBase(ModelKernel):
         grad_fn = jax.grad(loss_fn)
 
         bf16 = jnp.bfloat16
+        v_bf16 = _v_dtype_mode() == "bf16"
         m0 = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a, bf16), params)
-        v0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+        v0 = jax.tree_util.tree_map(
+            lambda a: jnp.zeros_like(a, bf16 if v_bf16 else jnp.float32), params
+        )
+        sr_key = jax.random.fold_in(key, 0x5A)  # stochastic-rounding stream
 
         def step(carry, inp):
             p, m, v, t = carry
@@ -216,11 +252,28 @@ class _MLPBase(ModelKernel):
             m = jax.tree_util.tree_map(
                 lambda a, b: (b1 * a.astype(jnp.float32) + (1 - b1) * b
                               ).astype(bf16), m, g)
-            v = jax.tree_util.tree_map(
-                lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
+            if v_bf16:
+                # unbiased bf16 storage: per-step, per-leaf random bits
+                # derived from the (fit-seed, step) pair keep the scan
+                # carry free of PRNG state
+                kt = jax.random.fold_in(sr_key, t.astype(jnp.int32))
+                leaves, treedef = jax.tree_util.tree_flatten(v)
+                vkeys = jax.tree_util.tree_unflatten(
+                    treedef, list(jax.random.split(kt, len(leaves)))
+                )
+                v = jax.tree_util.tree_map(
+                    lambda a, b, k: _sr_bf16(
+                        b2 * a.astype(jnp.float32) + (1 - b2) * b * b, k
+                    ),
+                    v, g, vkeys,
+                )
+            else:
+                v = jax.tree_util.tree_map(
+                    lambda a, b: b2 * a + (1 - b2) * b * b, v, g)
             mhat = jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.float32) / (1 - b1**t), m)
-            vhat = jax.tree_util.tree_map(lambda a: a / (1 - b2**t), v)
+            vhat = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32) / (1 - b2**t), v)
             p = jax.tree_util.tree_map(
                 lambda a, mh, vh: a - lr * mh / (jnp.sqrt(vh) + eps), p, mhat, vhat
             )

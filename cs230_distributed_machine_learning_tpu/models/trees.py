@@ -184,8 +184,11 @@ class _TreeBase(ModelKernel):
         compiled program but don't land in ``static`` — they must key every
         executable cache (same hazard the SVC solver knobs hit: a knob flip
         silently reloading the pre-knob AOT blob)."""
+        from ..ops.trees import _hist_kernel_mode
+
         return (
             os.environ.get("CS230_DEEP_WSCHED", ""),
+            _hist_kernel_mode(),  # resolved, not raw: aliases share a key
             os.environ.get("CS230_HIST_COMPACT", "0"),
             os.environ.get("CS230_HIST_BLOCK_ROWS", ""),
             os.environ.get("CS230_HIST_BLOCK_NODES", ""),

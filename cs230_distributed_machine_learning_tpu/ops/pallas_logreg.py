@@ -127,6 +127,102 @@ def packed_softmax_grad(
     )(Ab, W3, y2, WSP)
 
 
+def _masked_grad_kernel(a_ref, w_ref, y_ref, wm_ref, g_ref, *, c: int):
+    """One row-tile grid step of the per-lane masked gradient.
+
+    a_ref  [bm, dpp]  bf16  design-matrix row tile (shared by every lane)
+    w_ref  [dpp, cp]  bf16  one lane's weights, classes zero-padded to cp
+    y_ref  [bm, 1]    i32   labels for the tile rows
+    wm_ref [bm, 1]    f32   per-(sample, split) {0,1} fold weight (or any
+                            non-negative sample weight)
+    g_ref  [dpp, cp]  f32   output accumulator, revisited across row tiles
+
+    The fold mask streams through VMEM with the row tile and is applied to
+    the residual *inside* the kernel — the masked copies of the
+    probabilities / residual never exist in HBM. The Gram product
+    ``A^T @ r`` runs with bf16 operands and f32 accumulation (the MXU's
+    native mode), reduced across row tiles in the f32 output block.
+    """
+    i = pl.program_id(0)
+    a = a_ref[:]
+    logits = jnp.dot(a, w_ref[:], preferred_element_type=jnp.float32)  # [bm, cp]
+    col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+    # zero-padded weight columns produce logits == 0 which would pollute
+    # the softmax: mask them to -inf-ish before the row max
+    logits = jnp.where(col < c, logits, -1e30)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    e = jnp.exp(logits - m)
+    p = e / jnp.sum(e, axis=-1, keepdims=True)
+    onehot = (y_ref[:] == col).astype(jnp.float32)
+    r = ((p - onehot) * wm_ref[:]).astype(jnp.bfloat16)  # [bm, cp], VMEM-only
+
+    @pl.when(i == 0)
+    def _init():
+        g_ref[:] = jnp.zeros_like(g_ref)
+
+    g_ref[:] += jax.lax.dot_general(
+        a,
+        r,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("c", "bm", "interpret"))
+def masked_softmax_grad(Ab, W, y2, wm, *, c: int, bm: int = 256, interpret: bool = False):
+    """G = A^T @ (w * (softmax(A @ W) - Y)) for ONE (trial, split) lane.
+
+    The generic (non-packed) drivers' masked gradient as a fused kernel:
+    fold mask applied in-kernel, probabilities never materialized in HBM,
+    Gram product in bf16 with f32 reduction. Composes with ``jax.vmap``
+    (the engine's trials x splits batching adds grid dimensions).
+
+    Ab [n_pad, dpp] bf16 (n_pad % bm == 0; pad rows must carry wm == 0)
+    W  [dpp, cp]    bf16 (classes zero-padded to cp; cols >= c are ignored)
+    y2 [n_pad, 1]   i32
+    wm [n_pad, 1]   f32
+    returns G [dpp, cp] f32 (cols >= c are zero)
+    """
+    n_pad, dpp = Ab.shape
+    cp = W.shape[1]
+    assert n_pad % bm == 0, (n_pad, bm)
+    return pl.pallas_call(
+        functools.partial(_masked_grad_kernel, c=c),
+        grid=(n_pad // bm,),
+        in_specs=[
+            pl.BlockSpec((bm, dpp), lambda i: (i, 0)),
+            pl.BlockSpec((dpp, cp), lambda i: (0, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+            pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((dpp, cp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((dpp, cp), jnp.float32),
+        interpret=interpret,
+    )(Ab, W, y2, wm)
+
+
+def masked_softmax_grad_reference(Ab, W, y2, wm, *, c: int):
+    """Pure-XLA reference of ``masked_softmax_grad`` (same padded layout).
+
+    This is also the *fused-mask formulation* the solver uses on non-TPU
+    backends: the fold weight folds into the softmax normalizer
+    (``w * softmax(z) == exp(z - max) * (w / den)``), so a masked
+    iteration replaces softmax's [n, c] divide with an [n, 1] divide and
+    an [n, c] multiply — never costlier than an unmasked gradient, and no
+    masked copy of the probabilities is ever materialized as a separate
+    elementwise pass.
+    """
+    A = Ab.astype(jnp.float32)
+    cp = W.shape[1]
+    Z = A @ W.astype(jnp.float32)
+    col = jnp.arange(cp)[None, :]
+    Z = jnp.where(col < c, Z, -1e30)
+    e = jnp.exp(Z - jnp.max(Z, axis=-1, keepdims=True))
+    Pw = e * (wm / jnp.sum(e, axis=-1, keepdims=True))
+    WY = jnp.where(y2 == col, wm, 0.0)
+    return A.T @ (Pw - WY)
+
+
 def packed_softmax_grad_reference(Ab, W3, y2, WSP, *, c: int, S: int, Tw: int = TRIAL_BLOCK):
     """Pure-XLA reference of the kernel (same packing), for parity tests."""
     n_pad, dpp = Ab.shape

@@ -75,6 +75,43 @@ def bin_data(X, edges) -> jnp.ndarray:
 _HIST_ROW_CHUNK = 16384
 
 
+def _hist_kernel_mode() -> str:
+    """CS230_HIST_KERNEL valve over the level-histogram implementations:
+
+    - ``matmul``  — the XLA one-hot matmul contraction below (the
+      pre-PR-6 form; both 0/1 operands materialize in HBM);
+    - ``pallas``  — the fused Pallas kernel (ops/pallas_hist.py): one-hot
+      tiles built in VMEM, accumulator page resident across row tiles;
+    - ``scatter`` — the literal bin-and-scatter segment-sum form
+      (O(n*d*kk) adds; the fast form without an MXU);
+    - ``auto`` (default) — pallas on TPU for integer stats at eligible
+      shapes, scatter on CPU, matmul otherwise.
+
+    The valve is read at trace time and keyed into every executable cache
+    via the tree kernels' ``trace_salt``.
+    """
+    mode = os.environ.get("CS230_HIST_KERNEL", "auto").lower()
+    return mode if mode in ("auto", "matmul", "scatter", "pallas") else "auto"
+
+
+def _resolve_hist_kernel(integer_stats: bool, ds, n_binss, kk: int) -> str:
+    mode = _hist_kernel_mode()
+    if mode != "auto":
+        return mode
+    backend = jax.default_backend()
+    if backend == "tpu":
+        from .pallas_hist import pallas_hist_applicable
+
+        if integer_stats and all(
+            pallas_hist_applicable(d, nb, kk) for d, nb in zip(ds, n_binss)
+        ):
+            return "pallas"
+        return "matmul"  # float stats keep the HIGHEST-precision contraction
+    if backend == "cpu":
+        return "scatter"
+    return "matmul"
+
+
 def _level_histogram_multi(local, xbs, SC, n_nodes: int, n_binss,
                            precision=None, integer_stats: bool = False):
     """Feature-grouped level histograms in ONE row scan: a tuple of
@@ -97,10 +134,34 @@ def _level_histogram_multi(local, xbs, SC, n_nodes: int, n_binss,
     (< 128 — classification one-hots times bootstrap counts, which
     _bootstrap_counts caps): run the contraction as s8 x s8 -> s32 on the
     MXU (2x the bf16 rate on v5e), bit-exact by construction.
+
+    The CS230_HIST_KERNEL valve (see ``_hist_kernel_mode``) can replace
+    this whole contraction with the fused Pallas kernel or the
+    bin-and-scatter segment-sum form — all three share the contract and
+    the parity guarantees pinned in tests/test_pallas_hist.py.
     """
     n = xbs[0].shape[0]
     ds = tuple(xb.shape[1] for xb in xbs)
     kk = SC.shape[1]
+    kern = _resolve_hist_kernel(integer_stats, ds, n_binss, kk)
+    if kern == "scatter":
+        from .pallas_hist import level_histogram_scatter
+
+        return tuple(
+            level_histogram_scatter(local, xb, SC, n_nodes, nb)
+            for xb, nb in zip(xbs, n_binss)
+        )
+    if kern == "pallas":
+        from .pallas_hist import level_histogram_pallas
+
+        interp = jax.default_backend() != "tpu"
+        return tuple(
+            level_histogram_pallas(
+                local, xb, SC, n_nodes, nb,
+                integer_stats=integer_stats, interpret=interp,
+            )
+            for xb, nb in zip(xbs, n_binss)
+        )
     rc = min(_HIST_ROW_CHUNK, n)
     n_pad = ((n + rc - 1) // rc) * rc
     if n_pad != n:

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|chaos]   (default: fast)
+#   ./ci.sh [fast|kernels|chaos]   (default: fast)
 #
 #   fast mode:
 #   1. compileall lint gate — every .py in the package, tests, and
@@ -9,6 +9,13 @@
 #   2. tier-1 fast suite — the ROADMAP.md verify command: pytest on the
 #      virtual 8-device CPU mesh, slow (subprocess/chaos/minutes-long)
 #      suites excluded.
+#
+#   kernels mode: the interpret-mode kernel-parity suites ONLY — every
+#   Pallas kernel (packed/masked logreg gradients, level histogram, MLP
+#   epoch, KNN top-k) against its XLA reference on CPU, plus the valve
+#   plumbing (CS230_MASKED_GRAD / CS230_HIST_KERNEL) end to end. A few
+#   minutes; the job that makes a TPU-kernel regression fail without a
+#   TPU. Recipe + parity contracts: docs/KERNELS.md.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -41,7 +48,17 @@ python -m compileall -q cs230_distributed_machine_learning_tpu tests benchmarks
 # JSONL next to it.
 mkdir -p "$ART_DIR"
 rc=0
-if [ "$MODE" = "chaos" ]; then
+if [ "$MODE" = "kernels" ]; then
+  echo "== interpret-mode kernel-parity suite (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_pallas_logreg.py tests/test_pallas_hist.py \
+    tests/test_pallas_mlp.py tests/test_pallas_knn.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+elif [ "$MODE" = "chaos" ]; then
   echo "== chaos/durability suite (JAX_PLATFORMS=cpu, -m slow) =="
   CS230_JOURNAL_DIR="$ART_DIR/journal" \
   CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \

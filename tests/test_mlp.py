@@ -52,6 +52,64 @@ def test_mlp_lr_is_traced_same_bucket():
     assert s1 > s0  # tiny lr barely trains
 
 
+def test_mlp_bf16_second_moment_convergence_tolerance(monkeypatch):
+    """The bf16 second Adam moment (stochastically rounded, PR 6) must
+    land within tolerance of the f32-v trajectory — the quantizer is
+    unbiased, so the deterministic seed-0 fit may wiggle but not drift.
+    Also pins that the valve actually switches the state layout (the two
+    runs must not be bit-identical)."""
+    import jax
+
+    from cs230_distributed_machine_learning_tpu.parallel import trial_map
+
+    data, y = _scaled_iris()
+    plan = build_split_plan(y, task="classification", n_folds=3)
+    kernel = get_kernel("MLPClassifier")
+    params = [{"hidden_layer_sizes": (32,), "max_iter": 60, "random_state": 0}]
+
+    def run(mode):
+        monkeypatch.setenv("CS230_MLP_V_DTYPE", mode)
+        trial_map._compiled_cache.clear()
+        jax.clear_caches()
+        return run_trials(kernel, data, plan, params).trial_metrics[0]
+
+    m_bf16 = run("bf16")
+    m_f32 = run("f32")
+    assert abs(m_bf16["accuracy"] - m_f32["accuracy"]) <= 0.04, (m_bf16, m_f32)
+    assert abs(m_bf16["mean_cv_score"] - m_f32["mean_cv_score"]) <= 0.06, (
+        m_bf16, m_f32)
+    # both layouts clear the learning bars on their own
+    assert m_bf16["accuracy"] > 0.85 and m_f32["accuracy"] > 0.85
+
+
+def test_mlp_sr_bf16_is_unbiased_and_escapes_deadband():
+    """Property pin for the stochastic rounder: (1) unbiased within MC
+    error, (2) an EMA of sub-deadband updates tracks the f32 EMA instead
+    of freezing (the failure mode that forced v to stay f32 before)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cs230_distributed_machine_learning_tpu.models.mlp import _sr_bf16
+
+    key = jax.random.PRNGKey(3)
+    x = jnp.full((20000,), 1.001953125, jnp.float32)  # mid-deadband value
+    q = _sr_bf16(x, key).astype(jnp.float32)
+    assert abs(float(q.mean()) - float(x[0])) < 2e-4  # unbiased
+    assert float(jnp.abs(q - x).max()) <= 2 ** -7  # one bf16 ulp
+
+    # beta2=0.999-style EMA toward 2.0 from 1.0: nearest-rounding bf16
+    # freezes at 1.0 (update ~0.1% < 0.4% deadband); SR must track
+    v_sr, v_f32 = jnp.full((512,), 1.0, jnp.bfloat16), jnp.full((512,), 1.0)
+    for t in range(600):
+        v32 = 0.999 * v_sr.astype(jnp.float32) + 0.001 * 2.0
+        v_sr = _sr_bf16(v32, jax.random.fold_in(key, t))
+        v_f32 = 0.999 * v_f32 + 0.001 * 2.0
+    frozen = float(jnp.mean(jnp.abs(
+        jnp.full((512,), 1.0) - v_f32)))  # distance a frozen v would show
+    tracked = float(jnp.mean(jnp.abs(v_sr.astype(jnp.float32) - v_f32)))
+    assert tracked < 0.25 * frozen, (tracked, frozen)
+
+
 def test_mlp_regressor():
     from sklearn.datasets import make_regression
 
