@@ -11,14 +11,18 @@ flagship covertype design matrix:
                         the number that divides by the link bandwidth);
 - ``compress_ms``     — host-side ``_stage_compress`` wall (the CPU cost
                         paid before the upload can start);
-- ``upload_ms_local`` — ``device_put`` + block wall on THIS backend;
+- ``upload_ms_measured`` — ``device_put`` + block wall on THIS backend's
+                        REAL link (median of reps; no model);
 - ``decode_roundtrip_max_abs`` — |decode(compress(X)) - X| bound (the
                         score-tolerance contract pinned in
                         tests/test_packed_parity.py);
-- ``tunnel_upload_s_modeled`` — bytes_on_link / 9 MB/s, the r5-breakdown
-                        link model, CAVEATED in the note: no tunnel/TPU
-                        was reachable this round, so the real-link number
-                        stays a BENCH_r06 follow-up.
+- ``tunnel_upload_s_modeled`` — bytes_on_link / 9 MB/s, the historical
+                        r5-breakdown link model, kept for comparison.
+
+It also measures the link bandwidth the ``CS230_STAGE_DTYPE=auto`` policy
+probes (``trial_map._measured_link_mbps``: one 4 MiB device_put) and
+reports which staging dtype ``auto`` resolves to on this link against the
+``CS230_STAGE_AUTO_MBPS`` threshold.
 
 Writes benchmarks/STAGING_MICRO.json.
 
@@ -39,6 +43,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 from cs230_distributed_machine_learning_tpu.parallel.trial_map import (  # noqa: E402
+    _measured_link_mbps,
+    _resolve_stage_mode,
     _stage_compress,
     _stage_decode,
     _stage_mode_available,
@@ -86,34 +92,52 @@ def main() -> None:
         modes[mode] = {
             "bytes_on_link": nbytes,
             "compress_ms": round(float(np.median(walls)) * 1e3, 2),
-            "upload_ms_local": round(float(np.median(uploads)) * 1e3, 2),
+            "upload_ms_measured": round(float(np.median(uploads)) * 1e3, 2),
+            "upload_mb_per_s_measured": round(
+                nbytes / max(float(np.median(uploads)), 1e-9) / 1e6, 1
+            ),
             "decode_roundtrip_max_abs": float(err),
             "decode_roundtrip_max_rel_to_col_scale": rel,
             "tunnel_upload_s_modeled": round(nbytes / (TUNNEL_MBPS * 1e6), 2),
         }
     f32_bytes = modes["f32"]["bytes_on_link"]
+    # the auto-policy probe: the same 4 MiB device_put measurement
+    # run_trials consults when CS230_STAGE_DTYPE=auto picks a dtype
+    link_mbps = _measured_link_mbps()
+    auto_threshold = float(os.environ.get("CS230_STAGE_AUTO_MBPS", 100.0))
+    os.environ["CS230_STAGE_DTYPE"] = "auto"
+    auto_resolved = _resolve_stage_mode("auto")
     out = {
         "metric": "compressed_staging_micro",
         "backend": jax.default_backend(),
         "device": str(jax.devices()[0]),
         "dataset": f"covertype {X.shape[0]}x{X.shape[1]} f32",
         "tunnel_model_mb_per_s": TUNNEL_MBPS,
+        "link_probe_mb_per_s_measured": round(link_mbps, 1)
+        if link_mbps != float("inf") else None,
+        "auto_policy": {
+            "threshold_mb_per_s": auto_threshold,
+            "resolves_to": auto_resolved,
+            "rule": "bf16 when measured link < threshold, else f32",
+        },
         "modes": modes,
         "saving_vs_f32": {
             m: round(1.0 - v["bytes_on_link"] / f32_bytes, 3)
             for m, v in modes.items() if "bytes_on_link" in v
         },
         "note": (
-            "CS230_STAGE_DTYPE staging measured on the backend available "
-            "this round (no TPU/tunnel reachable): bytes_on_link and "
-            "compress_ms are exact and backend-independent; "
-            "tunnel_upload_s_modeled divides bytes by the nominal 9 MB/s "
-            "link. NOTE the r5 cold-start breakdown measured 3.4 s for "
-            "this 25.1 MB upload (~7.4 MB/s effective) — the RATIOS are "
-            "the robust number: bf16 halves, int8 quarters whatever the "
-            "link delivers, directly against the ROADMAP item-5 "
-            "cold_s <= 5 s bar. Real-link numbers fold into the "
-            "BENCH_r06 cold-start breakdown when a TPU round runs."
+            "CS230_STAGE_DTYPE staging measured for real on THIS "
+            "backend's link (upload_ms_measured / "
+            "upload_mb_per_s_measured are device_put+block medians, not "
+            "a model; the 9 MB/s tunnel_upload_s_modeled row is kept "
+            "only for comparison with the r5 breakdown). The auto "
+            "policy's probe measured link_probe_mb_per_s_measured and "
+            "resolves as reported — on this local link auto correctly "
+            "keeps f32; on a ~9 MB/s tunnel it picks bf16 and halves "
+            "the 3.4 s flagship upload. bytes_on_link ratios stay the "
+            "robust number: bf16 halves, int8 quarters whatever the "
+            "link delivers, against the ROADMAP item-5 cold_s <= 5 s "
+            "bar. A real-tunnel TPU round folds these into BENCH_r06."
         ),
     }
     with open(OUT, "w") as f:

@@ -1,0 +1,343 @@
+"""Multi-tenant staged-dataset cache: one device copy per (dataset, device).
+
+The per-TrialData staging cache in parallel/trial_map keyed device copies
+on the TrialData *object*, so N concurrent jobs that each resolved their
+own TrialData over the same public dataset re-staged it N times — N x the
+~3.4 s upload the r5 cold-start breakdown measured, for bytes already
+sitting in HBM (ROADMAP item 5; PAPER.md §2's task-farm shape makes the
+same-dataset fan-out the common case, not the corner).
+
+This module is the process-global replacement:
+
+- **content-fingerprint keys**: every staged entry is keyed by a sha1 over
+  the dataset's actual bytes + shape/dtype + ``n_classes`` + an optional
+  ``preprocess_salt`` attribute, plus the default device identity and the
+  caller's entry subkey (placement, staging dtype, prepared-form salt).
+  Two TrialData objects with identical content share one device copy; a
+  dtype or preprocessing difference can never collide.
+- **single-flight staging**: concurrent misses on one key perform exactly
+  ONE upload — later arrivals wait on the maker's event and reuse its
+  entry. ``stats()["uploads"]`` is the observable the concurrency
+  benchmark (benchmarks/staging_concurrency.py) and its fast test pin.
+- **refcounted LRU under a device-memory budget**: runs pin the entries
+  they touch (``pin_begin``/``pin_end``, wired through
+  ``trial_map.run_trials``); eviction walks LRU order, skips pinned
+  entries, and stops at ``CS230_STAGE_CACHE_MB`` (default: 40% of the
+  device's reported memory limit).
+- **observability**: ``tpuml_stage_cache_{hits,misses,uploads,evictions}
+  _total`` counters + ``tpuml_stage_cache_{bytes,entries}`` gauges
+  (docs/OBSERVABILITY.md), and ``stage.upload`` / ``stage.evict``
+  flight-recorder events.
+
+``CS230_STAGE_CACHE=0`` disables the module entirely and restores the
+legacy per-TrialData staging path bit-for-bit (parity-pinned in
+tests/test_stage_cache.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs import counter_inc, gauge_set, record_event
+from ..utils.logging import get_logger
+
+logger = get_logger("tpuml.stagecache")
+
+
+def enabled() -> bool:
+    """CS230_STAGE_CACHE=0 restores the legacy per-TrialData staging
+    cache (the parity valve). Read per call so tests can flip it live."""
+    return os.environ.get("CS230_STAGE_CACHE", "1") != "0"
+
+
+def budget_bytes() -> int:
+    """Device-memory budget for staged entries. ``CS230_STAGE_CACHE_MB``
+    pins it; the default is 40% of the device's reported bytes_limit
+    (backends without memory_stats fall back to the same 8 GB assumption
+    the trial engine's chunk planner uses)."""
+    env = os.environ.get("CS230_STAGE_CACHE_MB")
+    if env:
+        try:
+            return max(int(float(env) * 1e6), 1)
+        except ValueError:
+            pass
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            return int(0.4 * stats["bytes_limit"])
+    except Exception:  # noqa: BLE001 — no backend / no stats: fallback
+        pass
+    return int(0.4 * 8e9)
+
+
+def dataset_fingerprint(data) -> str:
+    """Content fingerprint of a TrialData: sha1 over the dataset bytes,
+    shape/dtype signature, n_classes, and the optional ``preprocess_salt``
+    attribute (preprocessing pipelines that rewrite bytes already move the
+    hash; the salt covers semantic changes that do not — e.g. a label
+    re-encode producing identical bytes by coincidence). Cached on the
+    TrialData object: the hash walks every byte once (~0.1 s for the 25 MB
+    covertype matrix), which is noise next to one staging upload but not
+    next to a cache hit."""
+    fp = getattr(data, "_content_fp", None)
+    if fp is not None:
+        return fp
+    import numpy as np
+
+    h = hashlib.sha1()
+    X = data.X
+    leaves = (
+        [X[k] for k in sorted(X)] if isinstance(X, dict) else [X]
+    )
+    for leaf in leaves:
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(repr((a.shape, str(a.dtype))).encode())
+        h.update(a.tobytes())
+    y = np.ascontiguousarray(np.asarray(data.y))
+    h.update(
+        repr((y.shape, str(y.dtype), int(getattr(data, "n_classes", 0)))).encode()
+    )
+    h.update(y.tobytes())
+    h.update(str(getattr(data, "preprocess_salt", "")).encode())
+    fp = h.hexdigest()
+    try:
+        object.__setattr__(data, "_content_fp", fp)
+    except Exception:  # noqa: BLE001 — exotic TrialData subclass: recompute
+        pass
+    return fp
+
+
+def _tree_nbytes(value: Any) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(value):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "refs")
+
+    def __init__(self, value: Any, nbytes: int):
+        self.value = value
+        self.nbytes = nbytes
+        #: live pins from in-flight runs — never evicted while > 0
+        self.refs = 0
+
+
+class StagedDatasetCache:
+    """Process-global refcounted LRU of device-resident staged tensors.
+
+    Keys are opaque tuples built by the trial engine:
+    ``(dataset_fingerprint, device_signature, *entry_subkey)``. Values are
+    whatever the staging ``make()`` returned (device arrays / pytrees).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[Any, _Entry]" = (
+            collections.OrderedDict()
+        )
+        #: key -> Event for a staging upload currently in flight
+        self._inflight: Dict[Any, threading.Event] = {}
+        self._bytes = 0
+        self._local = threading.local()
+        self._stats = {
+            "hits": 0,
+            "misses": 0,
+            "uploads": 0,
+            "evictions": 0,
+            "unevictable_overflows": 0,
+        }
+        #: per-key upload counts — the concurrency benchmark's observable
+        self._uploads_by_key: collections.Counter = collections.Counter()
+
+    # ---------------- pin scopes (refcounting) ----------------
+    #
+    # A run (trial_map.run_trials) opens a pin scope; every entry it
+    # touches gains one ref for the scope's lifetime, so eviction under
+    # memory pressure can never drop a tensor out from under an in-flight
+    # dispatch. Scopes are per-thread and nest (coordinator job threads
+    # and cluster workers each run their own).
+
+    def pin_begin(self) -> int:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(set())
+        return len(stack)
+
+    def pin_end(self, token: int) -> None:
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return
+        pinned = stack.pop()
+        with self._lock:
+            for key in pinned:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    entry.refs = max(0, entry.refs - 1)
+
+    def _pin_locked(self, key: Any) -> None:
+        stack = getattr(self._local, "stack", None)
+        if not stack:
+            return
+        scope = stack[-1]
+        if key not in scope:
+            scope.add(key)
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.refs += 1
+
+    # ---------------- lookup / staging ----------------
+
+    def get_or_stage(
+        self, key: Any, make: Callable[[], Any]
+    ) -> Tuple[Any, str]:
+        """Return ``(value, outcome)`` where outcome is ``"hit"`` (cached),
+        ``"wait"`` (another thread staged it while we waited — no upload
+        paid by THIS caller beyond the wait), or ``"miss"`` (this caller
+        performed the upload). Exactly one concurrent caller per key runs
+        ``make()``; a failed make releases the waiters to retry (the next
+        one becomes the maker)."""
+        waited = False
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._entries.move_to_end(key)
+                    self._stats["hits"] += 1
+                    self._pin_locked(key)
+                    counter_inc("tpuml_stage_cache_hits_total")
+                    return entry.value, ("wait" if waited else "hit")
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._inflight[key] = ev
+                    break
+            waited = True
+            ev.wait()
+
+        t0 = time.perf_counter()
+        try:
+            value = make()
+        except BaseException:
+            # release waiters to retry (one becomes the next maker)
+            with self._lock:
+                self._inflight.pop(key, None)
+            ev.set()
+            raise
+        wall_s = time.perf_counter() - t0
+        nbytes = _tree_nbytes(value)
+        evicted: List[Tuple[Any, int]] = []
+        with self._lock:
+            self._entries[key] = _Entry(value, nbytes)
+            self._entries.move_to_end(key)
+            self._bytes += nbytes
+            self._stats["misses"] += 1
+            self._stats["uploads"] += 1
+            self._uploads_by_key[key] += 1
+            self._pin_locked(key)
+            evicted = self._evict_over_budget_locked(exclude=key)
+            total_bytes, n_entries = self._bytes, len(self._entries)
+            # entry inserted: waiters must see it BEFORE the event fires,
+            # or they would loop back into a duplicate upload
+            self._inflight.pop(key, None)
+        ev.set()
+        counter_inc("tpuml_stage_cache_misses_total")
+        counter_inc("tpuml_stage_cache_uploads_total")
+        gauge_set("tpuml_stage_cache_bytes", float(total_bytes))
+        gauge_set("tpuml_stage_cache_entries", float(n_entries))
+        record_event(
+            "stage.upload",
+            key=repr(key), nbytes=nbytes, wall_s=round(wall_s, 6),
+            cache_bytes=total_bytes, cache_entries=n_entries,
+        )
+        for ekey, enbytes in evicted:
+            counter_inc("tpuml_stage_cache_evictions_total")
+            record_event("stage.evict", key=repr(ekey), nbytes=enbytes)
+        return value, "miss"
+
+    def _evict_over_budget_locked(
+        self, exclude: Any = None
+    ) -> List[Tuple[Any, int]]:
+        """LRU eviction down to the budget, skipping pinned entries and
+        the just-inserted key (a single over-budget dataset must stage and
+        serve its run, then age out). Returns the evicted (key, nbytes)."""
+        budget = budget_bytes()
+        evicted: List[Tuple[Any, int]] = []
+        if self._bytes <= budget:
+            return evicted
+        for key in list(self._entries):
+            if self._bytes <= budget:
+                break
+            entry = self._entries[key]
+            if key == exclude or entry.refs > 0:
+                continue
+            del self._entries[key]
+            self._bytes -= entry.nbytes
+            self._stats["evictions"] += 1
+            evicted.append((key, entry.nbytes))
+        if self._bytes > budget:
+            # every survivor is pinned (or the newcomer itself): nothing
+            # more can go — record the overflow, never drop live tensors
+            self._stats["unevictable_overflows"] += 1
+        if evicted:
+            logger.info(
+                "Staged-dataset cache evicted %d entries (%.1f MB) to fit "
+                "the %.0f MB budget",
+                len(evicted), sum(nb for _, nb in evicted) / 1e6,
+                budget / 1e6,
+            )
+        return evicted
+
+    # ---------------- introspection / tests ----------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out = dict(self._stats)
+            out["entries"] = len(self._entries)
+            out["bytes"] = self._bytes
+            out["pinned"] = sum(
+                1 for e in self._entries.values() if e.refs > 0
+            )
+            return out
+
+    def uploads_by_key(self) -> Dict[Any, int]:
+        """Per-key upload counts since process start (or ``clear()``) —
+        the exactly-one-upload-per-(dataset, device) observable."""
+        with self._lock:
+            return dict(self._uploads_by_key)
+
+    def contains(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def keys(self) -> List[Any]:
+        with self._lock:
+            return list(self._entries)
+
+    def clear(self) -> None:
+        """Drop every entry and reset counters (tests)."""
+        with self._lock:
+            self._entries.clear()
+            self._uploads_by_key.clear()
+            self._bytes = 0
+            for k in self._stats:
+                self._stats[k] = 0
+        gauge_set("tpuml_stage_cache_bytes", 0.0)
+        gauge_set("tpuml_stage_cache_entries", 0.0)
+
+
+#: the process-global cache instance every executor/run shares
+STAGE_CACHE = StagedDatasetCache()

@@ -188,6 +188,46 @@ def register_catalog() -> None:
         "tpuml_aot_cache_misses_total",
         "AOT disk-cache misses (fresh trace/export)",
     )
+    # ---- staged-dataset cache (docs/OBSERVABILITY.md "Data-plane
+    # caching") ----
+    c(
+        "tpuml_stage_cache_hits_total",
+        "Staged-dataset cache hits (a device-resident tensor reused "
+        "across jobs)",
+    )
+    c(
+        "tpuml_stage_cache_misses_total",
+        "Staged-dataset cache misses (a staging upload was required)",
+    )
+    c(
+        "tpuml_stage_cache_uploads_total",
+        "Actual host->device staging uploads performed — exactly one per "
+        "(dataset, device, staging form) under concurrent same-dataset "
+        "jobs (single-flight contract)",
+    )
+    c(
+        "tpuml_stage_cache_evictions_total",
+        "Staged entries LRU-evicted under the device-memory budget",
+    )
+    g(
+        "tpuml_stage_cache_bytes",
+        "Device bytes held by the staged-dataset cache",
+    )
+    g(
+        "tpuml_stage_cache_entries",
+        "Entries resident in the staged-dataset cache",
+    )
+    # ---- background AOT prewarm (docs/OBSERVABILITY.md "Data-plane
+    # caching") ----
+    c(
+        "tpuml_prewarm_warmed_total",
+        "Prewarm hints warmed (executables constructed + tensors staged "
+        "in the background), labeled by model",
+    )
+    c(
+        "tpuml_prewarm_skipped_total",
+        "Prewarm hints skipped, labeled by reason (duplicate|error)",
+    )
     c("tpuml_http_requests_total", "REST requests served, labeled by endpoint")
     c("tpuml_trace_spans_ingested_total", "Remote spans accepted via /trace_spans")
     g("tpuml_workers_alive", "Workers currently registered with the scheduler")

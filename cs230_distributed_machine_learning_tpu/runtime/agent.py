@@ -82,6 +82,13 @@ class WorkerAgent:
         self.url = coordinator_url.rstrip("/")
         self.poll_timeout_s = poll_timeout_s
         self._stop = threading.Event()
+        #: prewarm hints shipped in the /subscribe response (the runtime
+        #: predictor's hot families bound to recent job shapes); warmed in
+        #: the background by start() so the first placed trial finds a
+        #: loaded executable + staged dataset instead of the inline cold
+        #: path (runtime/prewarm.py; CS230_PREWARM=0 disables)
+        self._prewarm_hints: List[Dict[str, Any]] = []
+        self._prewarm = None
         self.worker_id = self._register(mem_capacity_mb, register_retries, register_backoff_s)
         self.executor = _make_executor(self.url, self.worker_id, mesh, max_batch)
         self._threads: List[threading.Thread] = []
@@ -106,8 +113,13 @@ class WorkerAgent:
                     timeout=10,
                 )
                 resp.raise_for_status()
-                wid = resp.json()["worker_id"]
-                logger.info("Registered with coordinator as %s", wid)
+                body = resp.json()
+                wid = body["worker_id"]
+                self._prewarm_hints = body.get("prewarm") or []
+                logger.info(
+                    "Registered with coordinator as %s (%d prewarm hints)",
+                    wid, len(self._prewarm_hints),
+                )
                 return wid
             except Exception as e:  # noqa: BLE001
                 last_err = e
@@ -116,6 +128,15 @@ class WorkerAgent:
         raise ConnectionError(f"Could not register with {self.url}: {last_err}")
 
     def start(self) -> None:
+        from .prewarm import PrewarmWorker, enabled as prewarm_enabled
+
+        if prewarm_enabled() and self._prewarm_hints:
+            # background AOT prewarm: bounded, yields to real batches
+            # (executor.busy), single-process agents only — SPMD slices
+            # skip it (run_distributed never calls start(); a rank-local
+            # warm dispatch would desync the lockstep collectives)
+            self._prewarm = PrewarmWorker(self.executor, self._prewarm_hints)
+            self._prewarm.start()
         for target in (self._run_loop, self._heartbeat_loop):
             t = threading.Thread(target=target, daemon=True)
             t.start()
@@ -123,6 +144,8 @@ class WorkerAgent:
 
     def stop(self, unsubscribe: bool = True) -> None:
         self._stop.set()
+        if self._prewarm is not None:
+            self._prewarm.stop()
         if unsubscribe:
             try:
                 import requests

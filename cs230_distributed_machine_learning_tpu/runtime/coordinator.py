@@ -733,6 +733,56 @@ class Coordinator:
             "events": timeline,
         }
 
+    def prewarm_hints(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Prewarm hints for a freshly-registered worker: the most recent
+        job shape per (model family, dataset), ranked by the runtime
+        predictor's hot families (``PlacementEngine.hot_families`` — the
+        families the fleet has actually been running), newest-first within
+        a rank. Shipped in the ``POST /subscribe`` response so the agent's
+        background prewarm (runtime/prewarm.py) can load those
+        executables and stage those datasets BEFORE the first placement
+        arrives. Empty when ``CS230_PREWARM=0`` or nothing has run yet."""
+        from .prewarm import enabled as prewarm_enabled
+        from .prewarm import max_hints
+
+        if not prewarm_enabled():
+            return []
+        limit = limit if limit is not None else max_hints()
+        if limit <= 0:
+            return []
+        hints: Dict[Any, Dict[str, Any]] = {}
+        # jobs_overview is newest-first: the first job seen per
+        # (family, dataset) is the most recent shape of that family.
+        # hint_shape extracts one param dict + scalar train_params per
+        # selected job — NOT the get_job deep copy, which would serialize
+        # every subtask spec/result of thousand-trial jobs under the
+        # store lock on every /subscribe (agent restarts re-register
+        # routinely under the fault-tolerance layer)
+        for job in self.store.jobs_overview():
+            family, dataset_id = job.get("model_type"), job.get("dataset_id")
+            if not family or not dataset_id or (family, dataset_id) in hints:
+                continue
+            try:
+                shape = self.store.hint_shape(
+                    job["session_id"], job["job_id"]
+                )
+            except Exception:  # noqa: BLE001 — evicted/foreign job
+                continue
+            hints[(family, dataset_id)] = {
+                "model_type": family,
+                "dataset_id": dataset_id,
+                **shape,
+            }
+        ranked = list(hints.values())
+        hot = (
+            self.cluster.engine.hot_families(top_n=max(limit, 5))
+            if self.cluster is not None
+            else []
+        )
+        rank = {family: i for i, family in enumerate(hot)}
+        ranked.sort(key=lambda h: rank.get(h["model_type"], len(rank)))
+        return ranked[:limit]
+
     def predictor_calibration(self) -> Dict[str, Any]:
         """Per-model-family predicted-vs-actual calibration of the runtime
         predictor driving placement/lease decisions — the

@@ -163,6 +163,16 @@ class LocalExecutor:
         self.fault_injector = fault_injector
         self.enable_profiler = cfg.execution.enable_profiler
         self.profiler_dir = cfg.execution.profiler_dir
+        #: live run_subtasks calls — the prewarm worker's yield signal
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    @property
+    def busy(self) -> bool:
+        """True while at least one subtask batch is executing. The
+        background prewarm worker (runtime/prewarm.py) polls this and
+        yields the device to real placements."""
+        return self._inflight > 0
 
     def run_subtasks(
         self,
@@ -173,6 +183,23 @@ class LocalExecutor:
     ) -> List[Dict[str, Any]]:
         """Run subtasks grouped by (dataset, model_type); returns results in
         input order. Callbacks fire per subtask as batches complete."""
+        with self._inflight_lock:
+            self._inflight += 1
+        try:
+            return self._run_subtasks(
+                subtasks, on_result=on_result, on_metrics=on_metrics
+            )
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
+
+    def _run_subtasks(
+        self,
+        subtasks: List[Dict[str, Any]],
+        *,
+        on_result: Optional[ResultCallback] = None,
+        on_metrics: Optional[MetricsCallback] = None,
+    ) -> List[Dict[str, Any]]:
         results: List[Optional[Dict[str, Any]]] = [None] * len(subtasks)
         groups: Dict[Any, List[int]] = {}
         for i, st in enumerate(subtasks):
@@ -465,6 +492,73 @@ class LocalExecutor:
         record_phase(batch_sp, "executor.fetch", run.fetch_time_s, start=t,
                      n_host_fetches=run.n_host_fetches,
                      result_bytes=run.result_bytes)
+
+    def prewarm_hint(
+        self, hint: Dict[str, Any], mode: str = "construct"
+    ) -> Dict[str, Any]:
+        """Warm one coordinator prewarm hint: resolve the dataset (which
+        fetches + parses it on a cold agent and stages it into the
+        multi-tenant device cache), then construct every bucket executable
+        the hinted job shape would use (``run_trials(warm_only=True)`` —
+        AOT blob deserialize or trace, the inline cold cost this kills).
+        ``mode="execute"`` additionally dispatches the warmed bucket once
+        with the hinted parameters and discards the result, so the first
+        real trial also finds a finished XLA compile.
+
+        Hint schema (Coordinator.prewarm_hints): ``{model_type,
+        dataset_id, parameters, n_trials, train_params}`` — ``n_trials``
+        matters because the trial-chunk geometry is part of every
+        executable cache key; warming the wrong chunk warms nothing.
+        It is capped at THIS executor's ``max_trials_per_batch``: a
+        scheduled worker never sees more trials per batch than its
+        long-poll cap (agent._poll_tasks passes exactly this value), so
+        the full-batch geometry — what a saturated queue delivers cold —
+        is the shape worth warming, and a bigger hinted job would warm a
+        chunk size no delivered batch ever has. String ``scoring``
+        survives into the warm (it is part of the executable key);
+        callable scoring cannot arrive here (REST-serialized hints)."""
+        kernel = get_kernel(hint["model_type"])
+        data = self.cache.get(hint["dataset_id"], kernel.task)
+        tp = dict(hint.get("train_params") or {})
+        scoring = tp.get("scoring")
+        scoring = _normalize_scoring(
+            scoring if isinstance(scoring, str) else None,
+            kernel.task, data.n_classes, kernel,
+        )
+        plan = build_split_plan(
+            data.y if kernel.task == "regression" else _np(data.y),
+            task=kernel.task,
+            n_folds=_coerce_cv(tp.get("cv")),
+            test_size=float(
+                tp.get("test_size", get_config().execution.default_test_size)
+            ),
+            random_state=tp.get("random_state", 42),
+        )
+        n_trials = max(
+            1, min(int(hint.get("n_trials") or 1), self.max_trials_per_batch)
+        )
+        params = dict(hint.get("parameters") or {})
+        run = run_trials(
+            kernel,
+            data,
+            plan,
+            [params] * n_trials,
+            mesh=self.mesh,
+            trial_axis=self.trial_axis,
+            max_trials_per_batch=self.max_trials_per_batch,
+            scoring=scoring,
+            warm_only=(mode != "execute"),
+        )
+        return {
+            "model_type": hint["model_type"],
+            "dataset_id": hint["dataset_id"],
+            "n_trials": n_trials,
+            "mode": mode,
+            "compile_s": round(run.compile_time_s, 6),
+            "stage_s": round(run.stage_time_s, 6),
+            "run_s": round(run.run_time_s, 6),
+            "n_dispatches": run.n_dispatches,
+        }
 
     def fit_artifact(self, subtask: Dict[str, Any]) -> Dict[str, Any]:
         """Refit one configuration on the holdout-train split and return a

@@ -50,6 +50,12 @@ class RuntimePredictor:
     #: EWMA smoothing for the per-family predicted/actual ratio gauge
     CALIB_EMA_ALPHA = 0.2
 
+    #: recent-family window: the last N observed model families back
+    #: ``hot_families()`` — the prewarm hint ranking (a family the fleet
+    #: has been running is the one whose cold AOT load the NEXT worker
+    #: to register should pay in the background, not inline)
+    HOT_WINDOW = 512
+
     #: replay-buffer depth: every refit trains on the last N observations,
     #: not just the latest 10-sample batch. The reference refit on each
     #: batch alone (scheduler_service.py:72-84), so its model FORGOT all
@@ -74,6 +80,10 @@ class RuntimePredictor:
         self._pending = 0
         self._history: collections.deque = collections.deque(
             maxlen=int(replay_size or self.REPLAY_SIZE)
+        )
+        #: last HOT_WINDOW observed model families (most recent last)
+        self._family_recent: collections.deque = collections.deque(
+            maxlen=self.HOT_WINDOW
         )
         #: model family -> deque[(predicted_s, actual_s)] (CALIB_WINDOW)
         self._calib: Dict[str, collections.deque] = {}
@@ -112,7 +122,12 @@ class RuntimePredictor:
 
     def observe(self, task: Dict[str, Any], actual_runtime_s: float) -> None:
         feats = self.features(task)
+        # executor metrics messages carry the family as "algo" (reference
+        # schema); synthetic/test feedback uses "model_type"
+        family = task.get("model_type") or task.get("algo")
         with self._lock:
+            if family and "_family_recent" in self.__dict__:
+                self._family_recent.append(str(family))
             self._history.append((feats, float(actual_runtime_s)))
             self._pending += 1
             if self._pending < self.refit_batch:
@@ -120,6 +135,17 @@ class RuntimePredictor:
             self._pending = 0
             replay = list(self._history)
         self._refit(replay)
+
+    def hot_families(self, top_n: int = 5) -> list:
+        """Model families ranked by recent observation frequency — the
+        prewarm hint ordering (docs/ARCHITECTURE.md "Data-plane caching
+        and prewarm"). Empty for stub predictors constructed without
+        ``RuntimePredictor.__init__`` and before any observation."""
+        if "_family_recent" not in self.__dict__:
+            return []
+        with self._lock:
+            counts = collections.Counter(self._family_recent)
+        return [family for family, _ in counts.most_common(top_n)]
 
     # ---------------- calibration ----------------
 

@@ -198,6 +198,14 @@ class PlacementEngine:
                 for wid, w in self.workers.items()
             }
 
+    def hot_families(self, top_n: int = 5) -> List[str]:
+        """The runtime predictor's recently-hot model families — what the
+        coordinator ships as the AOT-prewarm hint ranking when a worker
+        registers (runtime/prewarm.py). [] for stub predictors without
+        the surface (engine-level tests)."""
+        hf = getattr(self.predictor, "hot_families", None)
+        return hf(top_n=top_n) if hf is not None else []
+
     # ---------------- per-worker health ----------------
 
     def record_outcome(self, worker_id: str, ok: bool) -> None:

@@ -621,7 +621,18 @@ def create_app(coordinator: Optional[Coordinator] = None):
     def subscribe(request):
         body = request.get_json(silent=True) or {}
         wid = _cluster_or_400().register_remote(body.get("mem_capacity_mb"))
-        return _json({"worker_id": wid}, status=201)
+        resp = {"worker_id": wid}
+        try:
+            # predictor-driven AOT prewarm hints (docs/ARCHITECTURE.md
+            # "Data-plane caching and prewarm"): hot job shapes the new
+            # worker should warm in the background before first placement
+            hints = coord.prewarm_hints()
+        except Exception:  # noqa: BLE001 — hints are advisory, never
+            # allowed to fail a registration
+            hints = []
+        if hints:
+            resp["prewarm"] = hints
+        return _json(resp, status=201)
 
     def unsubscribe(request, wid):
         _cluster_or_400().unregister_remote(wid)

@@ -102,6 +102,33 @@ class JobStore:
         out.sort(key=lambda j: j.get("created_at") or 0, reverse=True)
         return out
 
+    def hint_shape(self, sid: str, job_id: str) -> Dict[str, Any]:
+        """Lightweight prewarm-hint extract for one job: the FIRST
+        subtask's parameters, the payload's scalar train_params, and the
+        subtask count — without the full-job deep copy ``get_job`` pays
+        (``prewarm_hints`` runs on every ``/subscribe``, and a long-lived
+        coordinator holds thousand-subtask jobs whose specs/results must
+        not be serialized under the store lock per registration).
+        Raises KeyError for unknown ids."""
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            subtasks = job.get("subtasks") or {}
+            first = next(iter(subtasks.values()), None)
+            params = ((first or {}).get("spec") or {}).get("parameters") or {}
+            train_params = (job.get("payload") or {}).get("train_params") or {}
+            out = {
+                # specs/payload were json_safe'd at create_job, so the
+                # round trip is safe — and it only serializes ONE param
+                # dict, not the job
+                "parameters": json.loads(json.dumps(params)),
+                "train_params": {
+                    k: v for k, v in train_params.items()
+                    if isinstance(v, (str, int, float, bool, type(None)))
+                },
+                "n_trials": int(job.get("total_subtasks") or 1),
+            }
+        return out
+
     # ---------------- jobs ----------------
 
     def create_job(

@@ -155,6 +155,26 @@ def _blob_path(key_parts: Sequence[Any]) -> str:
     return os.path.join(cache_dir(), _generation(), f"{digest}.jaxexport")
 
 
+def generation_inventory() -> dict:
+    """Blob count/bytes of the CURRENT cache generation — what a prewarm
+    pass (runtime/prewarm.py) can load without tracing. One cheap
+    directory scan; zeros when the cache is disabled or empty."""
+    out = {"n_blobs": 0, "bytes": 0, "dir": None}
+    try:
+        if not enabled():
+            return out
+        gen_dir = os.path.join(cache_dir(), _generation())
+        out["dir"] = gen_dir
+        with os.scandir(gen_dir) as it:
+            for entry in it:
+                if entry.name.endswith(".jaxexport"):
+                    out["n_blobs"] += 1
+                    out["bytes"] += entry.stat().st_size
+    except OSError:
+        pass
+    return out
+
+
 def aot_jit(fn, key_parts: Sequence[Any], example_args: Tuple[Any, ...]):
     """Return (callable, source) where source is "aot" (deserialized, no
     tracing) or "traced". The callable has the same signature as ``fn`` and

@@ -8,7 +8,11 @@
 #      deps beyond the stdlib);
 #   2. tier-1 fast suite — the ROADMAP.md verify command: pytest on the
 #      virtual 8-device CPU mesh, slow (subprocess/chaos/minutes-long)
-#      suites excluded.
+#      suites excluded. This includes the PR-8 data-plane suites
+#      (tests/test_stage_cache.py: single-flight staging, refcount/LRU
+#      eviction, fingerprint collision safety, CS230_STAGE_CACHE=0
+#      parity; tests/test_prewarm.py: hint derivation, yield-to-work,
+#      never-warm-twice, /subscribe handshake).
 #
 #   kernels mode: the interpret-mode kernel-parity suites ONLY — every
 #   Pallas kernel (packed/masked logreg gradients, level histogram, MLP
@@ -68,6 +72,19 @@ elif [ "$MODE" = "chaos" ]; then
     tests/test_durability.py tests/test_fault_tolerance.py \
     -q -m slow \
     --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  # concurrent-jobs staging benchmark: asserts exactly one upload per
+  # (dataset, device) under 8 parallel jobs and refreshes the committed
+  # JSON; kept OUTSIDE $ART_DIR so green runs still publish it (ci.yml
+  # uploads bench-artifacts/ unconditionally on the chaos job)
+  echo "== staging-concurrency benchmark (O(1) uploads contract) =="
+  mkdir -p bench-artifacts
+  if JAX_PLATFORMS=cpu python benchmarks/staging_concurrency.py \
+      > bench-artifacts/staging_concurrency.log 2>&1; then
+    cp benchmarks/STAGING_CONCURRENCY.json bench-artifacts/ || true
+  else
+    echo "staging_concurrency FAILED (see bench-artifacts/staging_concurrency.log)"
+    rc=1
+  fi
 else
   echo "== tier-1 fast suite (JAX_PLATFORMS=cpu, -m 'not slow') =="
   CS230_JOURNAL_DIR="$ART_DIR/journal" \

@@ -134,10 +134,17 @@ def test_bf16_staging_within_documented_tolerance(_transfer_env):
         assert abs(mb["mean_cv_score"] - mf["mean_cv_score"]) <= _BF16_SCORE_TOL
         assert abs(mb["accuracy"] - mf["accuracy"]) <= _BF16_SCORE_TOL
 
-    # the staged device copy really is narrow: the upload was the point
-    staged = getattr(data, "_device_cache", {})
-    bf16_entries = [k for k in staged if "bf16" in k]
-    assert bf16_entries, list(staged)
+    # the staged device copy really is narrow: the upload was the point.
+    # Staged entries live in the multi-tenant stage cache by default
+    # (data/stage_cache.py) and on the TrialData object under
+    # CS230_STAGE_CACHE=0 — check whichever holds them.
+    from cs230_distributed_machine_learning_tpu.data import stage_cache as sc
+
+    keys = list(getattr(data, "_device_cache", None) or {})
+    if sc.enabled():
+        keys += sc.STAGE_CACHE.keys()
+    bf16_entries = [k for k in keys if "bf16" in k]
+    assert bf16_entries, keys
 
 
 def test_int8_staging_scores_close_to_f32(_transfer_env):

@@ -1,0 +1,368 @@
+"""Background AOT prewarm (runtime/prewarm.py): hint derivation from the
+predictor's hot families, the register->warm handshake, yield-to-real-work
+and never-warm-twice guarantees, and the CS230_PREWARM=0 parity valve."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cs230_distributed_machine_learning_tpu.runtime import prewarm as pw
+from cs230_distributed_machine_learning_tpu.runtime.predictor import (
+    RuntimePredictor,
+)
+
+
+class _FakeExecutor:
+    """Records prewarm_hint calls; busy is externally controlled."""
+
+    def __init__(self):
+        self.busy = False
+        self.calls = []
+
+    def prewarm_hint(self, hint, mode="construct"):
+        self.calls.append((hint["model_type"], mode))
+        return {
+            "model_type": hint["model_type"],
+            "dataset_id": hint.get("dataset_id"),
+            "n_trials": hint.get("n_trials", 1),
+            "mode": mode, "compile_s": 0.0, "stage_s": 0.0,
+        }
+
+
+def _hint(family="LogisticRegression", dataset="d1", n=4):
+    return {
+        "model_type": family, "dataset_id": dataset, "parameters": {},
+        "n_trials": n, "train_params": {},
+    }
+
+
+# ---------------- worker semantics ----------------
+
+
+def test_worker_yields_to_real_work():
+    """While the executor has live batches, the prewarm thread sleeps —
+    it must never compete with a placement for the device."""
+    ex = _FakeExecutor()
+    ex.busy = True
+    worker = pw.PrewarmWorker(ex, [_hint()], yield_poll_s=0.01)
+    worker.start()
+    time.sleep(0.15)
+    assert not ex.calls and not worker.done.is_set()
+    ex.busy = False
+    assert worker.join(5.0)
+    assert [c[0] for c in ex.calls] == ["LogisticRegression"]
+
+
+def test_worker_never_warms_a_family_twice():
+    ex = _FakeExecutor()
+    hints = [_hint(), dict(_hint()), _hint(family="GaussianNB")]
+    worker = pw.PrewarmWorker(ex, hints, limit=10)
+    worker.start()
+    assert worker.join(5.0)
+    assert [c[0] for c in ex.calls] == [
+        "LogisticRegression", "GaussianNB",
+    ]
+
+
+def test_worker_survives_a_failing_hint():
+    class _Flaky(_FakeExecutor):
+        def prewarm_hint(self, hint, mode="construct"):
+            if hint["model_type"] == "boom":
+                raise RuntimeError("bad hint")
+            return super().prewarm_hint(hint, mode)
+
+    ex = _Flaky()
+    worker = pw.PrewarmWorker(ex, [_hint("boom"), _hint("GaussianNB")])
+    worker.start()
+    assert worker.join(5.0)
+    assert [c[0] for c in ex.calls] == ["GaussianNB"]
+
+
+def test_worker_respects_hint_limit_and_stop():
+    ex = _FakeExecutor()
+    worker = pw.PrewarmWorker(
+        ex, [_hint(f"m{i}", dataset=f"d{i}") for i in range(10)], limit=2
+    )
+    worker.start()
+    assert worker.join(5.0)
+    assert len(ex.calls) == 2
+    stopped = pw.PrewarmWorker(ex, [_hint("late")])
+    stopped._stop.set()
+    stopped.start()
+    assert stopped.join(5.0)
+
+
+def test_prewarm_valve_off(monkeypatch):
+    monkeypatch.setenv("CS230_PREWARM", "0")
+    assert pw.prewarm_mode() == "off"
+    assert not pw.enabled()
+    ex = _FakeExecutor()
+    worker = pw.PrewarmWorker(ex, [_hint()])
+    worker.start()
+    assert worker.join(1.0)
+    assert not ex.calls  # off: start() completes immediately, warms nothing
+    monkeypatch.setenv("CS230_PREWARM", "execute")
+    assert pw.prewarm_mode() == "execute"
+
+
+# ---------------- predictor hot families + engine passthrough ----------------
+
+
+def test_predictor_hot_families_ranked_by_recency_window():
+    p = RuntimePredictor(model_path=None, refit_batch=10**9)
+    for _ in range(5):
+        p.observe({"model_type": "RandomForestClassifier"}, 1.0)
+    for _ in range(2):
+        p.observe({"model_type": "LogisticRegression"}, 1.0)
+    p.observe({"algo": "GaussianNB"}, 1.0)  # executor metrics carry "algo"
+    hot = p.hot_families(top_n=2)
+    assert hot == ["RandomForestClassifier", "LogisticRegression"]
+    assert "GaussianNB" in p.hot_families(top_n=5)
+
+
+def test_engine_hot_families_passthrough_and_stub_safety():
+    from cs230_distributed_machine_learning_tpu.runtime.scheduler import (
+        PlacementEngine,
+    )
+
+    class _Stub:
+        def predict(self, task):
+            return 1.0
+
+        def observe(self, task, actual):
+            pass
+
+    engine = PlacementEngine(predictor=_Stub())
+    assert engine.hot_families() == []
+    engine2 = PlacementEngine(predictor=RuntimePredictor(
+        model_path=None, refit_batch=10**9
+    ))
+    engine2.predictor.observe({"model_type": "SVC"}, 2.0)
+    assert engine2.hot_families() == ["SVC"]
+
+
+# ---------------- executable warm end to end ----------------
+
+
+def _staged_dataset(name="pwtest", n=300, d=5):
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        stage_arrays,
+    )
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(int)
+    stage_arrays(name, X, y)
+    return name
+
+
+def test_prewarm_hint_warms_executables_the_real_run_hits():
+    """construct-mode warm builds the exact executables (same cache keys:
+    same dataset shape, chunk geometry, splits) a real batch then reuses
+    — the first trial skips the inline AOT-load/trace."""
+    from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+
+    dataset = _staged_dataset()
+    executor = LocalExecutor()
+    hint = {
+        "model_type": "GaussianNB", "dataset_id": dataset,
+        "parameters": {}, "n_trials": 2, "train_params": {"cv": 2},
+    }
+    summary = executor.prewarm_hint(hint)
+    assert summary["mode"] == "construct" and summary["n_dispatches"] == 0
+
+    hits = REGISTRY.counter("tpuml_executable_cache_hits_total").value()
+    results = executor.run_subtasks([
+        {
+            "subtask_id": f"s{i}", "job_id": "j1", "dataset_id": dataset,
+            "model_type": "GaussianNB", "parameters": {},
+            "train_params": {"cv": 2},
+        }
+        for i in range(2)
+    ])
+    assert all(r["status"] == "completed" for r in results)
+    assert (
+        REGISTRY.counter("tpuml_executable_cache_hits_total").value() > hits
+    )
+
+
+def test_prewarm_caps_geometry_at_the_workers_batch_cap():
+    """A scheduled worker never executes more trials per batch than its
+    long-poll cap (max_trials_per_batch), and chunk geometry is part of
+    every executable cache key — so a 1000-trial hint must warm the
+    full-batch geometry, not a chunk size no delivered batch ever has."""
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+
+    dataset = _staged_dataset("pwcap")
+    executor = LocalExecutor(max_trials_per_batch=4)
+    summary = executor.prewarm_hint({
+        "model_type": "GaussianNB", "dataset_id": dataset,
+        "parameters": {}, "n_trials": 1000, "train_params": {"cv": 2},
+    })
+    assert summary["n_trials"] == 4
+
+
+def test_prewarm_keeps_string_scoring_in_the_warm_key():
+    """String scorers survive REST and join the executable cache key —
+    dropping them would warm a default-scorer executable the real
+    batch never hits."""
+    from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+
+    dataset = _staged_dataset("pwscore")
+    executor = LocalExecutor()
+    tp = {"cv": 2, "scoring": "f1"}
+    executor.prewarm_hint({
+        "model_type": "GaussianNB", "dataset_id": dataset,
+        "parameters": {}, "n_trials": 2, "train_params": tp,
+    })
+    hits = REGISTRY.counter("tpuml_executable_cache_hits_total").value()
+    results = executor.run_subtasks([
+        {
+            "subtask_id": f"sc{i}", "job_id": "j1", "dataset_id": dataset,
+            "model_type": "GaussianNB", "parameters": {},
+            "train_params": tp,
+        }
+        for i in range(2)
+    ])
+    assert all(r["status"] == "completed" for r in results)
+    assert "f1" in results[0]
+    assert (
+        REGISTRY.counter("tpuml_executable_cache_hits_total").value() > hits
+    )
+
+
+def test_store_hint_shape_is_light_and_scalar_filtered():
+    """hint_shape returns just the warm-relevant shape (first subtask's
+    parameters + scalar train_params + trial count) without the get_job
+    full-job deep copy; non-scalar train_params are dropped."""
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+
+    dataset = _staged_dataset("pwshape")
+    coord = Coordinator()
+    sid = coord.create_session()
+    out = coord.submit_train(sid, {
+        "dataset_id": dataset,
+        "model_details": {
+            "model_type": "GaussianNB",
+            "search_type": "GridSearchCV",
+            "param_grid": {"var_smoothing": [1e-9, 1e-8]},
+        },
+        "train_params": {
+            "cv": 2, "test_size": 0.2, "random_state": 0,
+            "cv_list_like": [1, 2, 3],  # non-scalar: filtered from hints
+        },
+    })
+    coord.wait_for_completion(sid, out["job_id"], timeout_s=120)
+    shape = coord.store.hint_shape(sid, out["job_id"])
+    assert shape["n_trials"] == 2
+    assert shape["parameters"] == {"var_smoothing": 1e-9}
+    assert shape["train_params"]["cv"] == 2
+    assert "cv_list_like" not in shape["train_params"]
+    with pytest.raises(KeyError):
+        coord.store.hint_shape(sid, "nope")
+
+
+def test_prewarm_execute_mode_dispatches_and_discards():
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+
+    dataset = _staged_dataset("pwexec")
+    executor = LocalExecutor()
+    summary = executor.prewarm_hint(
+        {
+            "model_type": "GaussianNB", "dataset_id": dataset,
+            "parameters": {}, "n_trials": 2, "train_params": {"cv": 2},
+        },
+        mode="execute",
+    )
+    assert summary["n_dispatches"] >= 1
+
+
+# ---------------- coordinator hints + /subscribe handshake ----------------
+
+
+def _run_tiny_job(coord, dataset):
+    sid = coord.create_session()
+    out = coord.submit_train(sid, {
+        "dataset_id": dataset,
+        "model_details": {"model_type": "GaussianNB", "parameters": {}},
+        "train_params": {"cv": 2, "test_size": 0.2, "random_state": 0},
+    })
+    coord.wait_for_completion(sid, out["job_id"], timeout_s=120)
+    return out["job_id"]
+
+
+def test_coordinator_prewarm_hints_from_recent_jobs():
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+
+    dataset = _staged_dataset("pwhints")
+    coord = Coordinator()
+    assert coord.prewarm_hints() == []  # nothing ran yet
+    _run_tiny_job(coord, dataset)
+    hints = coord.prewarm_hints()
+    assert len(hints) == 1
+    hint = hints[0]
+    assert hint["model_type"] == "GaussianNB"
+    assert hint["dataset_id"] == dataset
+    assert hint["n_trials"] == 1
+    assert hint["train_params"]["cv"] == 2
+
+
+def test_coordinator_prewarm_hints_valve(monkeypatch):
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+
+    dataset = _staged_dataset("pwvalve")
+    coord = Coordinator()
+    _run_tiny_job(coord, dataset)
+    monkeypatch.setenv("CS230_PREWARM", "0")
+    assert coord.prewarm_hints() == []
+
+
+def test_subscribe_response_ships_prewarm_hints():
+    """The register->hint handshake over the real REST surface: a worker
+    subscribing after a job ran receives that job's shape to warm."""
+    from werkzeug.test import Client
+
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        ClusterRuntime,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.server import (
+        create_app,
+    )
+
+    dataset = _staged_dataset("pwrest")
+    cluster = ClusterRuntime()
+    try:
+        coord = Coordinator(cluster=cluster)
+        client = Client(create_app(coord))
+        # before any job: registration succeeds with no hints
+        body = client.post("/subscribe", json={}).get_json()
+        assert "worker_id" in body and "prewarm" not in body
+        cluster.add_executor()
+        _run_tiny_job(coord, dataset)
+        body = client.post("/subscribe", json={}).get_json()
+        assert body.get("prewarm"), body
+        assert body["prewarm"][0]["model_type"] == "GaussianNB"
+        assert body["prewarm"][0]["dataset_id"] == dataset
+    finally:
+        cluster.shutdown()

@@ -1,0 +1,266 @@
+"""Multi-tenant staged-dataset cache (data/stage_cache.py): single-flight
+uploads, content-fingerprint keying, refcounted LRU eviction under a
+device-memory budget, the CS230_STAGE_CACHE=0 parity valve, the
+CS230_STAGE_DTYPE=auto policy, and the upload-counter contract the
+concurrency benchmark (benchmarks/staging_concurrency.py) relies on."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cs230_distributed_machine_learning_tpu.data import stage_cache as sc
+from cs230_distributed_machine_learning_tpu.models.base import TrialData
+from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+from cs230_distributed_machine_learning_tpu.parallel import trial_map as tm
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    sc.STAGE_CACHE.clear()
+    yield
+    sc.STAGE_CACHE.clear()
+
+
+def _data(n=200, d=6, seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(dtype)
+    y = (X[:, 0] > 0).astype(np.int32)
+    return TrialData(X=X, y=y, n_classes=2)
+
+
+# ---------------- single-flight / upload counter ----------------
+
+
+def test_single_flight_one_upload_under_concurrency():
+    """8 concurrent misses on one key perform exactly ONE make() — the
+    O(1)-uploads-per-(dataset, device) contract of the concurrency
+    benchmark, pinned fast here."""
+    made = []
+    barrier = threading.Barrier(8)
+
+    def make():
+        made.append(1)
+        time.sleep(0.05)  # wide window: every thread arrives mid-flight
+        return np.zeros(16, np.float32)
+
+    outcomes = []
+
+    def job():
+        barrier.wait()
+        _, outcome = sc.STAGE_CACHE.get_or_stage(("fp", "dev", "X"), make)
+        outcomes.append(outcome)
+
+    threads = [threading.Thread(target=job) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(made) == 1
+    assert sc.STAGE_CACHE.stats()["uploads"] == 1
+    assert outcomes.count("miss") == 1
+    assert set(outcomes) <= {"miss", "wait"}
+    assert sc.STAGE_CACHE.uploads_by_key()[("fp", "dev", "X")] == 1
+
+
+def test_failed_make_releases_waiters_to_retry():
+    order = []
+
+    def bad_then_good():
+        order.append("call")
+        if len(order) == 1:
+            raise RuntimeError("staging failed")
+        return np.zeros(4)
+
+    with pytest.raises(RuntimeError):
+        sc.STAGE_CACHE.get_or_stage(("k",), bad_then_good)
+    val, outcome = sc.STAGE_CACHE.get_or_stage(("k",), bad_then_good)
+    assert outcome == "miss" and val is not None
+
+
+# ---------------- fingerprint collision safety ----------------
+
+
+def test_fingerprint_same_content_same_key():
+    a, b = _data(seed=3), _data(seed=3)
+    assert a is not b
+    assert sc.dataset_fingerprint(a) == sc.dataset_fingerprint(b)
+
+
+def test_fingerprint_dtype_differs():
+    """Same values, different dtype: bf16/f32 stagings must never collide
+    (widened bytes would silently serve the wrong precision)."""
+    a = _data(seed=1, dtype=np.float32)
+    b = _data(seed=1, dtype=np.float64)
+    assert np.allclose(a.X, b.X)
+    assert sc.dataset_fingerprint(a) != sc.dataset_fingerprint(b)
+
+
+def test_fingerprint_preprocess_salt_differs():
+    a, b = _data(seed=2), _data(seed=2)
+    object.__setattr__(b, "preprocess_salt", "scaler-v2")
+    assert sc.dataset_fingerprint(a) != sc.dataset_fingerprint(b)
+
+
+def test_fingerprint_content_differs():
+    assert sc.dataset_fingerprint(_data(seed=4)) != sc.dataset_fingerprint(
+        _data(seed=5)
+    )
+
+
+# ---------------- refcounting + LRU eviction under pressure ----------------
+
+
+def test_lru_eviction_under_memory_budget(monkeypatch):
+    """Budget fits ~2 of 3 equal entries: the LRU one goes, the recently
+    used stays, and re-touching refreshes recency."""
+    monkeypatch.setenv("CS230_STAGE_CACHE_MB", "0.01")  # 10 kB
+    mk = lambda: np.zeros(1000, np.float32)  # 4 kB each  # noqa: E731
+    sc.STAGE_CACHE.get_or_stage(("a",), mk)
+    sc.STAGE_CACHE.get_or_stage(("b",), mk)
+    sc.STAGE_CACHE.get_or_stage(("a",), mk)  # refresh a
+    sc.STAGE_CACHE.get_or_stage(("c",), mk)  # over budget -> evict b (LRU)
+    assert sc.STAGE_CACHE.contains(("a",))
+    assert not sc.STAGE_CACHE.contains(("b",))
+    assert sc.STAGE_CACHE.contains(("c",))
+    assert sc.STAGE_CACHE.stats()["evictions"] == 1
+
+
+def test_pinned_entries_survive_memory_pressure(monkeypatch):
+    """A pinned (in-flight run) entry is never evicted, even as LRU; the
+    budget overflow is recorded instead. After the pin scope closes it
+    becomes evictable again."""
+    monkeypatch.setenv("CS230_STAGE_CACHE_MB", "0.008")  # 8 kB
+    mk = lambda: np.zeros(1000, np.float32)  # noqa: E731
+    token = sc.STAGE_CACHE.pin_begin()
+    sc.STAGE_CACHE.get_or_stage(("pinned",), mk)  # pinned by the scope
+    sc.STAGE_CACHE.get_or_stage(("lru",), mk)
+    assert sc.STAGE_CACHE.stats()["pinned"] >= 1
+    sc.STAGE_CACHE.get_or_stage(("new1",), mk)
+    sc.STAGE_CACHE.get_or_stage(("new2",), mk)
+    assert sc.STAGE_CACHE.contains(("pinned",))  # LRU yet untouchable
+    sc.STAGE_CACHE.pin_end(token)
+    assert sc.STAGE_CACHE.stats()["pinned"] == 0
+    sc.STAGE_CACHE.get_or_stage(("new3",), mk)
+    assert not sc.STAGE_CACHE.contains(("pinned",))  # now evictable
+
+
+# ---------------- trial-engine integration ----------------
+
+
+def _run(data, params=None, n_folds=2):
+    kernel = get_kernel("GaussianNB")
+    y = np.asarray(data.y)
+    plan = build_split_plan(
+        y, task="classification", n_folds=n_folds, test_size=0.2,
+        random_state=42,
+    )
+    return tm.run_trials(kernel, data, plan, [params or {}])
+
+
+def test_concurrent_tenants_stage_once():
+    """The tentpole contract end to end: 8 concurrent jobs, each with its
+    OWN TrialData over the same dataset content, stage exactly once per
+    (dataset, device, staged form) — one X upload + one fold-tensor
+    upload, upload counter pinned."""
+    datasets = [_data(seed=7) for _ in range(8)]
+    barrier = threading.Barrier(8)
+    errors = []
+
+    def job(d):
+        try:
+            barrier.wait()
+            run = _run(d)
+            assert run.trial_metrics
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=job, args=(d,)) for d in datasets]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    stats = sc.STAGE_CACHE.stats()
+    assert stats["uploads"] == 2, stats  # X once, fold tensors once
+    assert max(sc.STAGE_CACHE.uploads_by_key().values()) == 1
+
+
+def test_stage_cache_parity_valve(monkeypatch):
+    """CS230_STAGE_CACHE=0 restores the legacy per-TrialData staging path
+    with identical results (the bit-for-bit valve of the acceptance
+    criteria)."""
+    on = _run(_data(seed=9), {"var_smoothing": 1e-9})
+    uploads_after_on = sc.STAGE_CACHE.stats()["uploads"]
+    monkeypatch.setenv("CS230_STAGE_CACHE", "0")
+    off = _run(_data(seed=9), {"var_smoothing": 1e-9})
+    assert on.trial_metrics == off.trial_metrics
+    # and the valve really bypassed the global cache: no new uploads,
+    # the legacy path staged onto the TrialData object instead
+    assert sc.STAGE_CACHE.stats()["uploads"] == uploads_after_on
+
+
+def test_run_pins_entries_only_while_running():
+    _run(_data(seed=11))
+    assert sc.STAGE_CACHE.stats()["entries"] >= 1
+    assert sc.STAGE_CACHE.stats()["pinned"] == 0  # scope closed with the run
+
+
+# ---------------- auto staging dtype ----------------
+
+
+def test_auto_stage_dtype_resolution(monkeypatch):
+    monkeypatch.setenv("CS230_STAGE_DTYPE", "auto")
+    monkeypatch.setenv("CS230_STAGE_LINK_MBPS", "5")  # tunneled-class link
+    assert tm._resolve_stage_mode(tm._staging_dtype()) in ("bf16", "f32")
+    try:
+        import ml_dtypes  # noqa: F401
+    except ImportError:
+        pytest.skip("ml_dtypes missing: auto degrades to f32")
+    assert tm._resolve_stage_mode(tm._staging_dtype()) == "bf16"
+    monkeypatch.setenv("CS230_STAGE_LINK_MBPS", "500")  # local-class link
+    assert tm._resolve_stage_mode(tm._staging_dtype()) == "f32"
+
+
+def test_auto_stage_dtype_stages_bf16_on_slow_link(monkeypatch):
+    try:
+        import ml_dtypes  # noqa: F401
+    except ImportError:
+        pytest.skip("ml_dtypes missing")
+    monkeypatch.setenv("CS230_STAGE_DTYPE", "auto")
+    monkeypatch.setenv("CS230_STAGE_LINK_MBPS", "5")
+    run = _run(_data(seed=13))
+    assert run.trial_metrics
+    assert any(
+        "bf16" in k for key in sc.STAGE_CACHE.uploads_by_key()
+        for k in key if isinstance(k, str)
+    )
+
+
+# ---------------- metrics catalog ----------------
+
+
+def test_stage_cache_metrics_in_prom_catalog():
+    """The cache/prewarm families are registered eagerly and visible in
+    the Prometheus exposition (docs parity is enforced separately by
+    test_flight_recorder's catalog gate)."""
+    from cs230_distributed_machine_learning_tpu.obs import (
+        REGISTRY,
+        render_prometheus,
+    )
+
+    names = REGISTRY.names()
+    for name in (
+        "tpuml_stage_cache_hits_total",
+        "tpuml_stage_cache_misses_total",
+        "tpuml_stage_cache_uploads_total",
+        "tpuml_stage_cache_evictions_total",
+        "tpuml_stage_cache_bytes",
+        "tpuml_stage_cache_entries",
+        "tpuml_prewarm_warmed_total",
+        "tpuml_prewarm_skipped_total",
+    ):
+        assert name in names
+        assert name in render_prometheus()
