@@ -33,6 +33,13 @@ lanes) into the terms that can possibly own it:
 - ``result_fetch``     — blocking device->host fetch of a [1024, 6] f32
                          score buffer (the packed single-fetch result of a
                          full chunk), measured end to end.
+- ``packed_step``      — the PACKED path's per-iteration wall, fused step
+                         kernel (CS230_FUSED_STEP=pallas, ISSUE 10) vs
+                         the legacy scan body, measured INTERLEAVED at
+                         two scan lengths so the eval epilogue and
+                         dispatch overhead difference out; plus the
+                         modeled per-iteration HBM traffic (bytes/iter
+                         before vs after) at the north-star shape.
 
 Measurement follows benchmarks/deep_profile.py: each in-jit component runs
 ITERS times inside one jitted fori_loop with iteration-dependent inputs
@@ -103,6 +110,167 @@ def wall_median(fn, reps=7):
         fn()
         walls.append(time.perf_counter() - t0)
     return float(np.median(walls))
+
+
+def _ceil_to(x, m):
+    return -(-x // m) * m
+
+
+def _packed_hbm_model(n, d, c, S, chunk, Tw=128):
+    """Modeled per-iteration HBM bytes of the packed Nesterov scan body.
+    (Deliberately independent of the row-tile size ``bm``: tiling changes
+    how the stream is chunked, not the total bytes moved.)
+
+    Stream terms (identical before/after): the bf16 design matrix, label
+    and fold-weight tiles, re-read once per weight block. Weight terms
+    (the fusion target): the legacy body's XLA elementwise round-trips
+    over the [n_wb, dpp, NB] f32 tensors vs the fused kernel's single
+    in-place read+write of W/Wp. ``legacy_weight_bytes`` assumes XLA
+    fuses every elementwise chain perfectly (the optimistic bound:
+    read W/Wp -> write V_bf16; kernel read V_bf16 -> write Graw; one
+    fused scale+gmax+writeback pass re-reading Graw/W/Wp and writing
+    W/Wp). ``legacy_weight_bytes_unfused`` materializes every named
+    intermediate (V f32, G) separately — the pessimistic bound."""
+    dp = d + 1
+    dpp = _ceil_to(dp, 64)
+    n_pad = _ceil_to(n, 2048)
+    n_wb = chunk // Tw
+    NB = c * S * Tw
+    Wt = n_wb * dpp * NB * 4  # one full f32 pass over the weight tensors
+    stream = n_wb * (n_pad * dpp * 2 + n_pad * 4 + n_pad * S * 4)
+    legacy_w = Wt * (2 + 0.5 + 0.5 + 1 + 1 + 2 + 2)  # 9 f32-equivalents
+    legacy_w_unfused = Wt * (2 + 1 + 1 + 0.5 + 0.5 + 1 + 2 + 1 + 2 + 2 + 2)
+    fused_w = Wt * 4  # W/Wp read + aliased in-place write
+    return {
+        "shape": {"n": n, "d": d, "n_classes": c, "splits": S,
+                  "chunk": chunk, "n_wb": n_wb, "dpp": dpp, "NB": NB},
+        "stream_bytes_per_iter": stream,
+        "weight_tensor_pass_bytes": Wt,
+        "legacy_weight_bytes_per_iter": legacy_w,
+        "legacy_weight_bytes_per_iter_unfused": legacy_w_unfused,
+        "fused_weight_bytes_per_iter": fused_w,
+        "legacy_total_bytes_per_iter": stream + legacy_w,
+        "fused_total_bytes_per_iter": stream + fused_w,
+        "total_reduction_pct_fused_vs_legacy": round(
+            100.0 * (legacy_w - fused_w) / (stream + legacy_w), 1
+        ),
+    }
+
+
+def measure_packed_step():
+    """Fused step kernel vs legacy scan body on the PACKED path, on this
+    backend. On CPU both variants run the Pallas kernel through the
+    interpreter (one interpret call per iteration either way — legacy
+    calls packed_softmax_grad, fused calls packed_nesterov_step), so the
+    comparison isolates exactly what the fusion removes: the XLA
+    elementwise round-trips around the gradient. Two scan lengths per
+    variant difference out the eval epilogue + dispatch overhead;
+    variants interleave round-robin (PR 6 precedent: their DELTA is the
+    signal and sequential best-of lets machine drift swamp it)."""
+    from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+
+    on_tpu = jax.default_backend() == "tpu"
+    # the packed path's TPU gate needs n >= 4096; CPU (interpret) keeps
+    # the smaller default so the section stays tractable through the
+    # Pallas interpreter
+    n = int(os.environ.get("PROF_PACK_N", 0)) or (4096 if on_tpu else 2048)
+    lo = int(os.environ.get("PROF_PACK_STEPS_LO", 2))
+    hi = int(os.environ.get("PROF_PACK_STEPS_HI", 6))
+    reps = int(os.environ.get("PROF_PACK_REPS", 3))
+    chunk, Tw = 128, 128
+    rng = np.random.RandomState(0)
+    saved = {k: os.environ.get(k)
+             for k in ("CS230_PALLAS_INTERPRET", "CS230_FUSED_STEP")}
+    if not on_tpu:
+        os.environ["CS230_PALLAS_INTERPRET"] = "1"
+    kernel = get_kernel("LogisticRegression")
+    X = jnp.asarray(rng.randn(n, D).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, C, n).astype(np.int32))
+    TW = jnp.asarray((rng.rand(S, n) > 0.3).astype(np.float32))
+    EW = jnp.asarray((rng.rand(S, n) > 0.5).astype(np.float32))
+    hyper = {
+        "C": jnp.asarray(np.geomspace(0.05, 5.0, chunk).astype(np.float32)),
+        # never converge, never hit max_iter: every scan step does work
+        "max_iter": jnp.full((chunk,), 1e6, jnp.float32),
+        "tol": jnp.zeros((chunk,), jnp.float32),
+    }
+    fns = {}
+    try:
+        for mode in ("legacy", "pallas"):
+            os.environ["CS230_FUSED_STEP"] = mode
+            for steps in (lo, hi):
+                static = {"fit_intercept": True, "penalty": "l2",
+                          "_method": "nesterov", "_n_classes": C,
+                          "_iters": steps}
+                fn = kernel.build_batched_fn(
+                    static=static, n=n, d=D, n_classes=C, n_splits=S,
+                    chunk=chunk,
+                )
+                if fn is None:
+                    # packed path not applicable at this shape/backend:
+                    # skip the section, never abort the whole harness
+                    msg = (f"packed path not applicable (backend="
+                           f"{jax.default_backend()}, n={n}) — section skipped")
+                    print(f"packed step: {msg}", flush=True)
+                    return {}, {"skipped": msg}
+                fns[(mode, steps)] = jax.jit(fn)
+    finally:
+        for k, v in saved.items():
+            os.environ.pop(k, None) if v is None else os.environ.__setitem__(k, v)
+
+    args = (X, y, TW, EW, hyper)
+    for f in fns.values():
+        sync(f(*args))  # compile + warm
+    walls = {k: [] for k in fns}
+    for _ in range(max(reps, 3)):
+        for k, f in fns.items():
+            t0 = time.perf_counter()
+            sync(f(*args))
+            walls[k].append(time.perf_counter() - t0)
+    per_iter = {}
+    for mode in ("legacy", "pallas"):
+        # pair same-rep walls so shared drift cancels in the difference
+        deltas = [
+            (b - a) / (hi - lo)
+            for a, b in zip(walls[(mode, lo)], walls[(mode, hi)])
+        ]
+        per_iter[mode] = deltas
+    metrics = {
+        "packed_step_legacy_ms_per_iter": min(per_iter["legacy"]) * 1e3,
+        "packed_step_fused_ms_per_iter": min(per_iter["pallas"]) * 1e3,
+        "packed_step_legacy_median_ms_per_iter": float(
+            np.median(per_iter["legacy"])
+        ) * 1e3,
+        "packed_step_fused_median_ms_per_iter": float(
+            np.median(per_iter["pallas"])
+        ) * 1e3,
+    }
+    spread = {
+        m: (max(v) - min(v)) / max(min(v), 1e-9)
+        for m, v in per_iter.items()
+    }
+    for mode, label in (("legacy", "packed step (legacy body):"),
+                        ("pallas", "packed step (fused kernel):")):
+        print(f"{label:30s}{min(per_iter[mode])*1e3:9.2f} ms/iter  "
+              f"(median {float(np.median(per_iter[mode]))*1e3:.2f}, "
+              f"spread {spread[mode]:.0%})", flush=True)
+    info = {
+        "backend_note": (
+            "compiled TPU kernels" if on_tpu else
+            "CPU: BOTH variants run their Pallas kernel through the "
+            "interpreter (one interpret call/iter each), so the delta "
+            "isolates the XLA elementwise round-trips the fusion removes"
+        ),
+        "pack_shape": {"n": n, "d": D, "n_classes": C, "splits": S,
+                       "chunk": chunk, "Tw": Tw},
+        "steps_lo_hi": [lo, hi],
+        "reps": max(reps, 3),
+        "spread_pct": {m: round(100 * s, 1) for m, s in spread.items()},
+        "hbm_bytes_per_iter_modeled_north_star": _packed_hbm_model(
+            116_202, 54, 7, 6, 1024
+        ),
+    }
+    return metrics, info
 
 
 def main() -> None:
@@ -203,6 +371,10 @@ def main() -> None:
               f"(median {float(np.median(walls[key]))*1e3:.2f}, "
               f"spread {spread:.0%})", flush=True)
 
+    # ---- 2b. packed scan body: fused step kernel vs legacy (ISSUE 10) ----
+    pack_metrics, pack_info = measure_packed_step()
+    results.update(pack_metrics)
+
     # ---- 3. Lipschitz power iteration (30 steps, per split) ----
     def power_step(i, carry):
         v, acc = carry
@@ -295,6 +467,7 @@ def main() -> None:
         "grad_variant_reps": grad_reps,
         "components": {k: round(v, 4) for k, v in results.items()},
         "attribution_per_trial": attribution,
+        "packed_step": pack_info,
         "note": (
             "in-jit components measured deep_profile-style (fori_loop, "
             "iteration-dependent inputs, dispatch floor subtracted by "
@@ -314,7 +487,17 @@ def main() -> None:
             "kept as the production path on op-count grounds (it strictly "
             "removes the per-iteration masked elementwise pass) and the "
             "Pallas lane/packed kernels apply the mask in VMEM on TPU; "
-            "re-measure on real TPU for the BENCH_r06 attribution."
+            "re-measure on real TPU for the BENCH_r06 attribution. "
+            "PACKED STEP (2026-08-03, PR 10): packed_step_* compares the "
+            "fused Nesterov step kernel (CS230_FUSED_STEP) against the "
+            "legacy scan body ON THIS BACKEND — on CPU both run one "
+            "interpreted Pallas call per iteration, so the delta is the "
+            "XLA elementwise traffic the fusion removes, NOT the MXU "
+            "win; the same +/-15-25% noise-floor caveat applies, and the "
+            "bytes/iter accounting under packed_step.hbm_bytes_per_iter_"
+            "modeled_north_star is a MODEL (optimistic-XLA-fusion legacy "
+            "bound vs the aliased in-place fused kernel), to be "
+            "validated by the TPU deep-profile in the BENCH_r06 round."
         ),
     }
     with open(OUT, "w") as f:

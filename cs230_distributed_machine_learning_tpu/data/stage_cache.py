@@ -14,7 +14,13 @@ This module is the process-global replacement:
   ``preprocess_salt`` attribute, plus the default device identity and the
   caller's entry subkey (placement, staging dtype, prepared-form salt).
   Two TrialData objects with identical content share one device copy; a
-  dtype or preprocessing difference can never collide.
+  dtype or preprocessing difference can never collide. Beyond raw
+  dataset tensors, the same keying carries *solver precomputes*: the
+  packed LogReg path stages its padded bf16 design matrix and its
+  per-(dataset, fold-signature) Lipschitz bound here
+  (``models/logistic.py::batched_staged_extras`` via the trial engine's
+  ``batched_extra`` subkeys), so repeat dispatches hit instead of
+  recomputing.
 - **single-flight staging**: concurrent misses on one key perform exactly
   ONE upload — later arrivals wait on the maker's event and reuse its
   entry. ``stats()["uploads"]`` is the observable the concurrency

@@ -38,21 +38,21 @@ from jax.experimental.pallas import tpu as pltpu
 TRIAL_BLOCK = 128
 
 
-def _grad_kernel(a_ref, w_ref, y_ref, wsp_ref, g_ref, *, c: int, S: int, Tw: int):
-    """One (weight-block, row-tile) grid step.
+def _tile_softmax_gram(a, W, yv, wsp_ref, acc_ref, *, c: int, S: int, Tw: int):
+    """Shared (row-tile x weight-block) gradient body of ``_grad_kernel``
+    and ``_fused_step_kernel``: logits -> grouped softmax -> masked
+    residual -> per-class Gram accumulation into ``acc_ref[0]``. The two
+    kernels MUST run op-for-op identical gradients (the fused-vs-legacy
+    parity contract), which this single body enforces by construction.
 
-    a_ref   [bm, dpp]      bf16  design-matrix row tile (shared by all trials)
-    w_ref   [1, dpp, NB]   bf16  packed weights, NB = c*S*Tw, class-major
-    y_ref   [bm, 1]        i32   labels for the tile rows
-    wsp_ref [bm, S]        f32   per-split {0,1} sample weights
-    g_ref   [1, dpp, NB]   f32   output: A^T (w (P - Y)), accumulated over row tiles
+    a   [bm, dpp]      bf16  design-matrix row tile (shared by all trials)
+    W   [dpp, NB]      bf16  packed weights operand, NB = c*S*Tw, class-major
+    yv  [bm, 1]        i32   labels for the tile rows
+    wsp_ref [bm, S]    f32   per-split {0,1} sample-weight ref
+    acc_ref [1, dpp, NB] f32 accumulator block, revisited across row tiles
     """
-    i = pl.program_id(1)
     B = S * Tw
-    bm = a_ref.shape[0]
-
-    a = a_ref[:]
-    W = w_ref[0]
+    bm = a.shape[0]
     # logits for every (class, split, trial) column: one MXU pass, f32 out
     logits = jnp.dot(a, W, preferred_element_type=jnp.float32)  # [bm, NB]
 
@@ -73,12 +73,6 @@ def _grad_kernel(a_ref, w_ref, y_ref, wsp_ref, g_ref, *, c: int, S: int, Tw: int
         den = den + es[a_i]
     rden = 1.0 / den
 
-    yv = y_ref[:]  # [bm, 1]
-
-    @pl.when(i == 0)
-    def _init():
-        g_ref[0] = jnp.zeros_like(g_ref[0])
-
     # per class: residual tile and its gradient contribution (7 small dots
     # instead of one concat keeps everything statically sliced)
     for a_i in range(c):
@@ -90,7 +84,25 @@ def _grad_kernel(a_ref, w_ref, y_ref, wsp_ref, g_ref, *, c: int, S: int, Tw: int
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [dpp, B]
-        g_ref[0, :, a_i * B : (a_i + 1) * B] += g_a
+        acc_ref[0, :, a_i * B : (a_i + 1) * B] += g_a
+
+
+def _grad_kernel(a_ref, w_ref, y_ref, wsp_ref, g_ref, *, c: int, S: int, Tw: int):
+    """One (weight-block, row-tile) grid step.
+
+    a_ref   [bm, dpp]      bf16  design-matrix row tile (shared by all trials)
+    w_ref   [1, dpp, NB]   bf16  packed weights, NB = c*S*Tw, class-major
+    y_ref   [bm, 1]        i32   labels for the tile rows
+    wsp_ref [bm, S]        f32   per-split {0,1} sample weights
+    g_ref   [1, dpp, NB]   f32   output: A^T (w (P - Y)), accumulated over row tiles
+    """
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        g_ref[0] = jnp.zeros_like(g_ref[0])
+
+    _tile_softmax_gram(a_ref[:], w_ref[0], y_ref[:], wsp_ref, g_ref, c=c, S=S, Tw=Tw)
 
 
 @functools.partial(jax.jit, static_argnames=("c", "S", "Tw", "bm", "interpret"))
@@ -125,6 +137,194 @@ def packed_softmax_grad(
         out_shape=jax.ShapeDtypeStruct((n_wb, dpp, NB), jnp.float32),
         interpret=interpret,
     )(Ab, W3, y2, WSP)
+
+
+#: conservative VMEM budget for the fused step's weight-resident blocks
+#: (W/Wp in + W/Wp out, all f32 — 16 bytes per (row, packed column)). The
+#: row-tile intermediates (logits, per-class exp tiles) match the plain
+#: gradient kernel's and are not re-counted here; this bounds only what
+#: the fused form ADDS over ``packed_softmax_grad``. Re-tune on real TPU
+#: (BENCH_r06 follow-up).
+_FUSED_STEP_VMEM_BYTES = 8 * 1024 * 1024
+
+
+def fused_step_applicable(dpp: int, NB: int, bm: int = 256) -> bool:
+    """VMEM gate for ``packed_nesterov_step``'s ``auto`` routing: the four
+    f32 weight blocks (W/Wp, in + aliased out) must fit the budget. Forced
+    modes (``CS230_FUSED_STEP=pallas``) bypass this — tests run tiny
+    shapes, and an operator forcing the kernel owns the consequences."""
+    return 16 * dpp * NB + 2 * bm * dpp <= _FUSED_STEP_VMEM_BYTES
+
+
+def _fused_step_kernel(
+    a_ref, w_ref, wp_ref, y_ref, wsp_ref, t_ref, done_ref, step_ref,
+    cb_ref, maxit_ref, pen_ref, wout_ref, wpout_ref, gmax_ref,
+    *, c: int, S: int, Tw: int, lam: float, n_tiles: int
+):
+    """One (weight-block, row-tile) grid step of the FULL Nesterov update.
+
+    a_ref     [bm, dpp]      bf16  design-matrix row tile (shared by all trials)
+    w_ref     [1, dpp, NB]   f32   W, packed class-major (NB = c*S*Tw)
+    wp_ref    [1, dpp, NB]   f32   W_prev
+    y_ref     [bm, 1]        i32   labels for the tile rows
+    wsp_ref   [bm, S]        f32   per-split {0,1} sample weights
+    t_ref     [1, 1]         f32   iteration index t (SMEM scalar)
+    done_ref  [1, B]         f32   1.0 where the trial already converged
+    step_ref  [1, B]         f32   per-(split, trial) step size
+    cb_ref    [1, B]         f32   per-trial C
+    maxit_ref [1, B]         f32   per-trial max_iter
+    pen_ref   [dpp, 1]       f32   L2 penalty row mask (0 on intercept/pad)
+    wout_ref  [1, dpp, NB]   f32   OUT W_new — aliased onto w_ref's buffer;
+                                   doubles as the cross-tile Gram accumulator
+    wpout_ref [1, dpp, NB]   f32   OUT Wp_new — aliased onto wp_ref's buffer
+    gmax_ref  [1, B]         f32   OUT per-(split, trial) max|G|
+
+    The look-ahead iterate ``V = W + mom*(W - Wp)`` is formed in VMEM from
+    the resident W/Wp blocks each row tile (VPU-cheap next to the tile's
+    MXU work) — V never exists in HBM. The raw gradient accumulates across
+    row tiles in the wout block; the LAST tile's epilogue applies the
+    per-trial C scaling + L2 penalty, reduces ``max|G|``, and performs the
+    done/max_iter-masked W/Wp writeback in place.
+    """
+    i = pl.program_id(1)
+    B = S * Tw
+    t = t_ref[0, 0]
+    mom = t / (t + 3.0)
+
+    @pl.when(i == 0)
+    def _init():
+        wout_ref[0] = jnp.zeros_like(wout_ref[0])
+
+    # look-ahead iterate, recomputed per tile from the VMEM-resident blocks
+    Vb = (w_ref[0] + mom * (w_ref[0] - wp_ref[0])).astype(jnp.bfloat16)
+    # the one shared gradient body with _grad_kernel (parity by
+    # construction), accumulating into the W_new output block
+    _tile_softmax_gram(a_ref[:], Vb, y_ref[:], wsp_ref, wout_ref, c=c, S=S, Tw=Tw)
+
+    @pl.when(i == n_tiles - 1)
+    def _epilogue():
+        W = w_ref[0]
+        Wp = wp_ref[0]
+        V = W + mom * (W - Wp)  # f32 this time: the writeback operand
+        cb = cb_ref[:]  # [1, B]
+        step = step_ref[:]
+        pen = pen_ref[:]  # [dpp, 1]
+        active = jnp.logical_and(t < maxit_ref[:], done_ref[:] == 0.0)  # [1, B]
+        gmax = None
+        for a_i in range(c):
+            sl = slice(a_i * B, (a_i + 1) * B)
+            Vb_ = V[:, sl]
+            G = cb * wout_ref[0, :, sl] + lam * (pen * Vb_)  # [dpp, B]
+            gm = jnp.max(jnp.abs(G), axis=0, keepdims=True)  # [1, B]
+            gmax = gm if gmax is None else jnp.maximum(gmax, gm)
+            wout_ref[0, :, sl] = jnp.where(active, Vb_ - step * G, W[:, sl])
+            wpout_ref[0, :, sl] = jnp.where(active, W[:, sl], Wp[:, sl])
+        gmax_ref[:] = gmax
+
+
+@functools.partial(
+    jax.jit, static_argnames=("c", "S", "Tw", "bm", "lam", "interpret")
+)
+def packed_nesterov_step(
+    Ab, W3, Wp3, y2, WSP, t, done, step_b, Cb, maxit_b, pen_col,
+    *, c: int, S: int, Tw: int = TRIAL_BLOCK, bm: int = 256,
+    lam: float = 0.0, interpret: bool = False,
+):
+    """ONE full Nesterov iteration of the packed LogReg fit, fused.
+
+    Replaces the legacy scan body's four XLA elementwise round-trips over
+    the ``[n_wb, dpp, NB]`` weight tensors (momentum extrapolation, C/L2
+    gradient scaling, the ``max|G|`` reduce, the done-masked writeback)
+    with in-VMEM epilogues around the streamed softmax-Gram gradient.
+    Per-iteration HBM traffic on the weight tensors drops from ~10 full
+    f32 passes to 4 (W/Wp read + W/Wp write, aliased in place).
+
+    Ab      [n_pad, dpp]     bf16  (n_pad % bm == 0; pad rows carry w == 0)
+    W3      [n_wb, dpp, NB]  f32   NB == c*S*Tw, column = (a*S + s)*Tw + t
+    Wp3     [n_wb, dpp, NB]  f32
+    y2      [n_pad, 1]       i32
+    WSP     [n_pad, S]       f32
+    t       scalar           f32   iteration index (momentum = t/(t+3))
+    done    [n_wb, B]        f32   1.0 freezes the (split, trial) column
+    step_b  [n_wb, B]        f32   per-column step size
+    Cb      [n_wb, B]        f32   per-column C
+    maxit_b [n_wb, B]        f32   per-column max_iter
+    pen_col [dpp, 1]         f32   L2 row mask (0 on intercept + pad rows)
+    lam     static float           L2 strength (0 disables the penalty)
+
+    Returns ``(W_new, Wp_new, gmax)`` with shapes/dtypes of
+    ``(W3, Wp3, [n_wb, B] f32)``. ALIASING CAVEAT: ``W3`` and ``Wp3`` are
+    donated to the outputs (``input_output_aliases``) — inside the solver
+    scan XLA updates them in place; a caller holding the input arrays
+    must treat them as consumed after the call.
+    """
+    n_pad, dpp = Ab.shape
+    n_wb, _, NB = W3.shape
+    B = S * Tw
+    assert NB == c * B, (NB, c, S, Tw)
+    assert n_pad % bm == 0, (n_pad, bm)
+    n_tiles = n_pad // bm
+
+    t2 = jnp.asarray(t, jnp.float32).reshape(1, 1)
+    kernel = functools.partial(
+        _fused_step_kernel, c=c, S=S, Tw=Tw, lam=float(lam), n_tiles=n_tiles
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(n_wb, n_tiles),
+        in_specs=[
+            pl.BlockSpec((bm, dpp), lambda wb, i: (i, 0)),
+            pl.BlockSpec((1, dpp, NB), lambda wb, i: (wb, 0, 0)),
+            pl.BlockSpec((1, dpp, NB), lambda wb, i: (wb, 0, 0)),
+            pl.BlockSpec((bm, 1), lambda wb, i: (i, 0)),
+            pl.BlockSpec((bm, S), lambda wb, i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda wb, i: (0, 0), memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, B), lambda wb, i: (wb, 0)),
+            pl.BlockSpec((1, B), lambda wb, i: (wb, 0)),
+            pl.BlockSpec((1, B), lambda wb, i: (wb, 0)),
+            pl.BlockSpec((1, B), lambda wb, i: (wb, 0)),
+            pl.BlockSpec((dpp, 1), lambda wb, i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, dpp, NB), lambda wb, i: (wb, 0, 0)),
+            pl.BlockSpec((1, dpp, NB), lambda wb, i: (wb, 0, 0)),
+            pl.BlockSpec((1, B), lambda wb, i: (wb, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_wb, dpp, NB), jnp.float32),
+            jax.ShapeDtypeStruct((n_wb, dpp, NB), jnp.float32),
+            jax.ShapeDtypeStruct((n_wb, B), jnp.float32),
+        ],
+        input_output_aliases={1: 0, 2: 1},
+        interpret=interpret,
+    )(Ab, W3, Wp3, y2, WSP, t2, done, step_b, Cb, maxit_b, pen_col)
+
+
+def packed_nesterov_step_reference(
+    Ab, W3, Wp3, y2, WSP, t, done, step_b, Cb, maxit_b, pen_col,
+    *, c: int, S: int, Tw: int = TRIAL_BLOCK, lam: float = 0.0,
+):
+    """Pure-XLA reference of ``packed_nesterov_step`` — literally the
+    legacy scan body's algebra (models/logistic.py pre-fusion) on the
+    same packed layout, for parity tests."""
+    n_wb, dpp, NB = W3.shape
+    B = S * Tw
+    t = jnp.asarray(t, jnp.float32)
+    mom = t / (t + 3.0)
+    V = W3 + mom * (W3 - Wp3)
+    Graw = packed_softmax_grad_reference(
+        Ab, V.astype(jnp.bfloat16), y2, WSP, c=c, S=S, Tw=Tw
+    )
+    cb_full = jnp.tile(Cb, (1, c))[:, None, :]  # [n_wb, 1, NB]
+    step_full = jnp.tile(step_b, (1, c))[:, None, :]
+    pen_row = pen_col.reshape(1, dpp, 1)
+    G = cb_full * Graw + lam * pen_row * V
+    gmax = jnp.max(jnp.abs(G).reshape(n_wb, dpp, c, B), axis=(1, 2))
+    active = jnp.logical_and(t < maxit_b, done == 0.0)  # [n_wb, B]
+    act = jnp.tile(active, (1, c))[:, None, :]
+    W_new = jnp.where(act, V - step_full * G, W3)
+    Wp_new = jnp.where(act, W3, Wp3)
+    return W_new, Wp_new, gmax
 
 
 def _masked_grad_kernel(a_ref, w_ref, y_ref, wm_ref, g_ref, *, c: int):

@@ -15,11 +15,13 @@
 #      never-warm-twice, /subscribe handshake).
 #
 #   kernels mode: the interpret-mode kernel-parity suites ONLY — every
-#   Pallas kernel (packed/masked logreg gradients, level histogram, MLP
-#   epoch, KNN top-k) against its XLA reference on CPU, plus the valve
-#   plumbing (CS230_MASKED_GRAD / CS230_HIST_KERNEL) end to end. A few
-#   minutes; the job that makes a TPU-kernel regression fail without a
-#   TPU. Recipe + parity contracts: docs/KERNELS.md.
+#   Pallas kernel (packed/masked logreg gradients, the fused packed
+#   Nesterov step incl. its aliasing + convergence-mask-edge contracts,
+#   level histogram, MLP epoch, KNN top-k) against its XLA reference on
+#   CPU, plus the valve plumbing (CS230_MASKED_GRAD / CS230_FUSED_STEP /
+#   CS230_HIST_KERNEL) end to end. A few minutes; the job that makes a
+#   TPU-kernel regression fail without a TPU. Recipe + parity
+#   contracts: docs/KERNELS.md.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease

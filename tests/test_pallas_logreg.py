@@ -17,8 +17,11 @@ from cs230_distributed_machine_learning_tpu.models.base import TrialData
 from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
 from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
 from cs230_distributed_machine_learning_tpu.ops.pallas_logreg import (
+    fused_step_applicable,
     masked_softmax_grad,
     masked_softmax_grad_reference,
+    packed_nesterov_step,
+    packed_nesterov_step_reference,
     packed_softmax_grad,
     packed_softmax_grad_reference,
 )
@@ -164,6 +167,205 @@ def test_fit_fused_masked_grad_matches_legacy(monkeypatch):
     W_legacy = fit("legacy", "nesterov")
     scale = np.abs(W_legacy).max() + 1e-9
     assert np.abs(W_pallas - W_legacy).max() / scale < 5e-3
+
+
+# ---------------- fused packed Nesterov step (ISSUE 10) ----------------
+
+
+def _fused_step_inputs(c, S, n_wb=2, n_pad=512, dpp=64, seed=0):
+    rng = np.random.RandomState(seed)
+    Tw = 128
+    B = S * Tw
+    NB = c * B
+    Ab = jnp.asarray(rng.randn(n_pad, dpp).astype(np.float32)).astype(
+        jnp.bfloat16
+    )
+    W = jnp.asarray((rng.randn(n_wb, dpp, NB) * 0.2).astype(np.float32))
+    Wp = jnp.asarray((rng.randn(n_wb, dpp, NB) * 0.2).astype(np.float32))
+    y2 = jnp.asarray(rng.randint(0, c, (n_pad, 1)).astype(np.int32))
+    WSP = jnp.asarray((rng.rand(n_pad, S) > 0.3).astype(np.float32))
+    done = jnp.asarray((rng.rand(n_wb, B) > 0.7).astype(np.float32))
+    step = jnp.asarray((0.01 + rng.rand(n_wb, B) * 0.1).astype(np.float32))
+    Cb = jnp.asarray((0.1 + rng.rand(n_wb, B)).astype(np.float32))
+    # mixed max_iter: half the columns sit AT/past the boundary (t >= 2)
+    maxit = jnp.asarray(
+        np.where(rng.rand(n_wb, B) > 0.5, 100.0, 2.0).astype(np.float32)
+    )
+    pen = np.ones((dpp, 1), np.float32)
+    pen[-10:] = 0.0  # intercept/pad rows unpenalized
+    return Ab, W, Wp, y2, WSP, done, step, Cb, maxit, jnp.asarray(pen), Tw
+
+
+@pytest.mark.parametrize("c,S,lam", [(2, 3, 2.0), (7, 3, 1.0), (3, 2, 0.0)])
+def test_fused_step_kernel_matches_reference_interpret(c, S, lam):
+    """packed_nesterov_step (momentum + masked gradient + C/L2 scaling +
+    max|G| reduce + done/max_iter-masked writeback, one VMEM pass) vs its
+    pure-XLA reference — the legacy scan-body algebra on the same packed
+    layout — at the bf16 Gram tolerance. Covers binary (doubled penalty),
+    7-class, and the unpenalized (lam=0) form, with done-frozen columns
+    and max_iter-boundary columns mixed in."""
+    Ab, W, Wp, y2, WSP, done, step, Cb, maxit, pen, Tw = _fused_step_inputs(c, S)
+    t = 3.0
+    got = packed_nesterov_step(
+        Ab, W, Wp, y2, WSP, t, done, step, Cb, maxit, pen,
+        c=c, S=S, Tw=Tw, bm=256, lam=lam, interpret=True,
+    )
+    ref = packed_nesterov_step_reference(
+        Ab, W, Wp, y2, WSP, t, done, step, Cb, maxit, pen,
+        c=c, S=S, Tw=Tw, lam=lam,
+    )
+    for name, g, r in zip(("W_new", "Wp_new", "gmax"), got, ref):
+        g, r = np.asarray(g), np.asarray(r)
+        scale = np.abs(r).max() + 1e-9
+        assert np.abs(g - r).max() / scale < 5e-3, name
+
+
+def test_fused_step_freezes_done_and_past_max_iter_columns():
+    """The writeback contract at the convergence-mask edges: a column with
+    done == 1, or with t >= its max_iter, keeps W and Wp EXACTLY (the
+    kernel must write the old values, not a near-copy)."""
+    c, S = 3, 2
+    Ab, W, Wp, y2, WSP, _, step, Cb, _, pen, Tw = _fused_step_inputs(c, S)
+    n_wb, _, _ = W.shape
+    B = S * Tw
+    done = jnp.zeros((n_wb, B), jnp.float32).at[:, ::3].set(1.0)
+    maxit = jnp.full((n_wb, B), 100.0, jnp.float32).at[:, 1::3].set(5.0)
+    t = 5.0  # AT the max_iter boundary: t < maxit is False for the 5.0 cols
+    W_new, Wp_new, _ = packed_nesterov_step(
+        Ab, W, Wp, y2, WSP, t, done, step, Cb, maxit, pen,
+        c=c, S=S, Tw=Tw, bm=256, lam=1.0, interpret=True,
+    )
+    frozen = np.zeros(B, bool)
+    frozen[::3] = True   # done
+    frozen[1::3] = True  # past max_iter
+    frozen_nb = np.tile(frozen, c)
+    W_new, Wp_new = np.asarray(W_new), np.asarray(Wp_new)
+    np.testing.assert_array_equal(W_new[:, :, frozen_nb], np.asarray(W)[:, :, frozen_nb])
+    np.testing.assert_array_equal(Wp_new[:, :, frozen_nb], np.asarray(Wp)[:, :, frozen_nb])
+    # active columns must actually move
+    assert np.abs(W_new[:, :, ~frozen_nb] - np.asarray(W)[:, :, ~frozen_nb]).max() > 0
+
+
+def test_fused_step_aliasing_is_invisible_at_the_api_boundary():
+    """The W/Wp buffers are aliased in place INSIDE the executable
+    (input_output_aliases); at the jit boundary the caller's arrays must
+    stay valid and un-mutated — two identical calls give identical
+    results and the inputs keep their original values."""
+    c, S = 2, 2
+    Ab, W, Wp, y2, WSP, done, step, Cb, maxit, pen, Tw = _fused_step_inputs(c, S)
+    W0 = np.asarray(W).copy()
+    args = (Ab, W, Wp, y2, WSP, 2.0, done, step, Cb, maxit, pen)
+    kw = dict(c=c, S=S, Tw=Tw, bm=256, lam=2.0, interpret=True)
+    out1 = packed_nesterov_step(*args, **kw)
+    out2 = packed_nesterov_step(*args, **kw)
+    for a, b in zip(out1, out2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(W), W0)
+
+
+def test_fused_step_vmem_gate():
+    """auto-mode routing: the north-star shape fits, a dpp=512 block
+    falls back to the legacy scan body."""
+    NB = 7 * 6 * 128
+    assert fused_step_applicable(64, NB, 256)
+    assert not fused_step_applicable(512, NB, 256)
+
+
+def _build_packed_fn(monkeypatch, mode, n, d, c, S, fit_intercept=True,
+                     steps=12, chunk=128):
+    """kernel.build_batched_fn under a CS230_FUSED_STEP mode, plus matching
+    random inputs (n deliberately NOT a multiple of the 2048 eval row
+    chunk, d NOT a multiple of 64 — the padded-geometry edges)."""
+    import jax
+
+    monkeypatch.setenv("CS230_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("CS230_FUSED_STEP", mode)
+    jax.clear_caches()
+    kernel = get_kernel("LogisticRegression")
+    static = {
+        "fit_intercept": fit_intercept, "penalty": "l2",
+        "_method": "nesterov", "_n_classes": c, "_iters": steps,
+    }
+    fn = kernel.build_batched_fn(
+        static=static, n=n, d=d, n_classes=c, n_splits=S, chunk=chunk
+    )
+    assert fn is not None
+    return kernel, static, fn
+
+
+def _packed_fn_inputs(n, d, c, S, chunk, seed=0):
+    rng = np.random.RandomState(seed)
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, c, n).astype(np.int32))
+    TW = jnp.asarray((rng.rand(S, n) > 0.3).astype(np.float32))
+    EW = jnp.asarray((rng.rand(S, n) > 0.5).astype(np.float32))
+    hyper = {
+        "C": jnp.asarray(np.geomspace(0.05, 5.0, chunk).astype(np.float32)),
+        "max_iter": jnp.asarray(
+            np.where(np.arange(chunk) % 2, 60.0, 3.0).astype(np.float32)
+        ),
+        "tol": jnp.asarray(np.full(chunk, 1e-4, np.float32)),
+    }
+    return X, y, TW, EW, hyper
+
+
+@pytest.mark.parametrize("c,fit_intercept", [(2, True), (7, True), (3, False)])
+def test_packed_fn_fused_matches_legacy_scan_body(monkeypatch, c, fit_intercept):
+    """End-to-end packed fn (fit scan + eval) parity: CS230_FUSED_STEP=
+    pallas vs legacy, across binary/7-class and fit_intercept on/off,
+    with per-trial max_iter below the scan cap (mask edges exercised) and
+    non-multiple n/d padding."""
+    n, d, S, chunk = 700, 5, 3, 128
+    _, _, fn_legacy = _build_packed_fn(
+        monkeypatch, "legacy", n, d, c, S, fit_intercept
+    )
+    X, y, TW, EW, hyper = _packed_fn_inputs(n, d, c, S, chunk)
+    score_legacy = np.asarray(fn_legacy(X, y, TW, EW, hyper)["score"])
+    _, _, fn_fused = _build_packed_fn(
+        monkeypatch, "pallas", n, d, c, S, fit_intercept
+    )
+    score_fused = np.asarray(fn_fused(X, y, TW, EW, hyper)["score"])
+    assert score_fused.shape == (chunk, S)
+    np.testing.assert_allclose(score_fused, score_legacy, atol=2e-3)
+
+
+def test_packed_fn_staged_extras_bitwise(monkeypatch):
+    """The staged forms (padded bf16 Ab, precomputed Lipschitz bound) fed
+    through hyper must reproduce the inline derivation BITWISE — they are
+    the same ops, hoisted."""
+    n, d, c, S, chunk = 700, 5, 3, 3, 128
+    kernel, static, fn = _build_packed_fn(monkeypatch, "pallas", n, d, c, S)
+    X, y, TW, EW, hyper = _packed_fn_inputs(n, d, c, S, chunk)
+    base = np.asarray(fn(X, y, TW, EW, hyper)["score"])
+
+    specs = kernel.batched_staged_extras(
+        static=static, n=n, d=d, n_classes=c, n_splits=S,
+        fold_signature=("test", 1),
+    )
+    assert set(specs) == {"_logreg_ab", "_logreg_lam_max"}
+    ctx = {"X": X, "y": y, "TW": TW, "EW": EW, "decode": lambda x: x}
+    extras = {name: make(ctx) for name, (subkey, make) in specs.items()}
+    assert extras["_logreg_ab"].dtype == jnp.bfloat16
+    assert extras["_logreg_lam_max"].shape == (S,)
+    with_extras = np.asarray(fn(X, y, TW, EW, {**hyper, **extras})["score"])
+    np.testing.assert_array_equal(with_extras, base)
+
+
+def test_packed_fn_legacy_mode_has_no_extras(monkeypatch):
+    """CS230_FUSED_STEP=legacy restores the pre-fusion path bit-for-bit:
+    no staged extras exist, everything is derived inline."""
+    monkeypatch.setenv("CS230_FUSED_STEP", "legacy")
+    kernel = get_kernel("LogisticRegression")
+    static = {
+        "fit_intercept": True, "penalty": "l2",
+        "_method": "nesterov", "_n_classes": 3,
+    }
+    monkeypatch.setenv("CS230_PALLAS_INTERPRET", "1")
+    assert kernel.batched_staged_extras(
+        static=static, n=700, d=5, n_classes=3, n_splits=3,
+        fold_signature=("sig",),
+    ) == {}
+    assert kernel.trace_salt()[1] == "legacy"
 
 
 def _toy(n=600, d=9, n_classes=3, seed=0):

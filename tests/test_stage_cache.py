@@ -208,6 +208,49 @@ def test_run_pins_entries_only_while_running():
     assert sc.STAGE_CACHE.stats()["pinned"] == 0  # scope closed with the run
 
 
+def test_logreg_packed_precomputes_staged_once(monkeypatch):
+    """The packed LogReg path's dispatch-invariant precomputes (the
+    per-split Lipschitz power iteration and the padded bf16 design
+    matrix, ISSUE 10 satellites) are staged-form cache entries: the
+    second run over the same (dataset, folds) pair is a pure cache hit —
+    exactly ONE upload per precompute key, ever."""
+    monkeypatch.setenv("CS230_PALLAS_INTERPRET", "1")
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 7).astype(np.float32)
+    y = rng.randint(0, 3, 600).astype(np.int32)
+    data = TrialData(X=X, y=y, n_classes=3)
+    plan = build_split_plan(data.y, task="classification", n_folds=3)
+    kernel = get_kernel("LogisticRegression")
+    orig_resolve = kernel.resolve_static
+    monkeypatch.setattr(
+        kernel,
+        "resolve_static",
+        lambda s, n, d, c: {**orig_resolve(s, n, d, c), "_method": "nesterov"},
+    )
+    params = [{"C": c, "max_iter": 15} for c in (0.1, 1.0)]
+
+    def extra_uploads():
+        return {
+            k: v
+            for k, v in sc.STAGE_CACHE.uploads_by_key().items()
+            if "batched_extra" in str(k)
+        }
+
+    first = tm.run_trials(kernel, data, plan, params)
+    ups = extra_uploads()
+    assert len(ups) == 2, ups  # lam_max + padded bf16 Ab
+    assert all("lam_max" in str(k) or "'ab'" in str(k) for k in ups)
+    assert all(v == 1 for v in ups.values()), ups
+    hits_before = sc.STAGE_CACHE.stats()["hits"]
+
+    second = tm.run_trials(kernel, data, plan, params)
+    ups2 = extra_uploads()
+    assert ups2 == ups, "second dispatch re-uploaded a precompute"
+    assert sc.STAGE_CACHE.stats()["hits"] >= hits_before + 2
+    for a, b in zip(first.trial_metrics, second.trial_metrics):
+        assert a["mean_cv_score"] == pytest.approx(b["mean_cv_score"])
+
+
 # ---------------- auto staging dtype ----------------
 
 
