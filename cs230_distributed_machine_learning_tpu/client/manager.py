@@ -22,6 +22,7 @@ polling /metrics (core.py:169,178), and the 60 s default timeout.
 from __future__ import annotations
 
 import json
+import random
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -172,9 +173,12 @@ class MLTaskManager:
                 return self._train_stream(
                     payload, timeout=timeout, show_progress=show_progress
                 )
+            # idempotent: the payload carries the client-minted job_id and
+            # the coordinator dedupes resubmits on it, so a retried POST
+            # (coordinator restart, 429 backoff) can never double-expand
             submit = self._request(
                 "post", f"train/{self.session_id}", json=json_safe(payload),
-                headers={TRACE_HEADER: self.trace_id},
+                headers={TRACE_HEADER: self.trace_id}, idempotent=True,
             )
         if not wait_for_completion:
             return submit
@@ -277,46 +281,102 @@ class MLTaskManager:
         """Remote-mode stream consumption: POST the job to ``/train_status``
         and read the SSE events off the response body (one request submits
         and follows). Events arrive every ``sse_tick_s``; a read stalled
-        well past that cadence — or the overall deadline — raises."""
+        well past that cadence — or the overall deadline — raises.
+
+        A DROPPED stream (coordinator restart, broken connection) is
+        resumed, not raised: the payload carries the client-minted job_id
+        and the coordinator dedupes resubmits on it, so re-POSTing the same
+        body re-attaches to the SAME job's stream and progress continues
+        from the last seen event (each SSE event is a full progress
+        snapshot — nothing between drop and resume is lost). 429/503
+        responses back off per their ``Retry-After``."""
         import requests
 
         cfg = get_config().service
         timeout = timeout or cfg.client_timeout_s
-        deadline = time.time() + timeout
+        start = time.time()
+        deadline = start + timeout
+        retry_window = max(cfg.request_retry_s, 0.0)
         read_timeout = max(10.0, 8 * cfg.sse_tick_s)
         bar = self._progress_bar(show_progress)
         last: Optional[Dict[str, Any]] = None
-        resp = requests.post(
-            f"{self.api_url}/train_status/{self.session_id}",
-            json=json_safe(payload),
-            headers={TRACE_HEADER: self.trace_id} if self.trace_id else None,
-            stream=True,
-            timeout=(10, read_timeout),
-        )
+        attempt = 0
+        established = False  # a stream was successfully opened at least once
         try:
-            resp.raise_for_status()
-            for raw in resp.iter_lines():
-                if not raw:
-                    continue
-                line = raw.decode() if isinstance(raw, bytes) else raw
-                if not line.startswith("data: "):
-                    continue
-                event = json.loads(line[len("data: "):])
-                last = event
-                if bar is not None:
-                    bar.n = int(_pct(event.get("job_status")))
-                    bar.refresh()
-                if event.get("job_status") in TERMINAL_STATUSES:
-                    break
-                if time.time() > deadline:
-                    raise TimeoutError(
-                        f"Job {self.job_id} did not complete within {timeout}s"
+            while time.time() < deadline:
+                try:
+                    resp = requests.post(
+                        f"{self.api_url}/train_status/{self.session_id}",
+                        json=json_safe(payload),
+                        headers={TRACE_HEADER: self.trace_id}
+                        if self.trace_id else None,
+                        stream=True,
+                        timeout=(10, read_timeout),
                     )
+                except (requests.ConnectionError, requests.Timeout):
+                    # an endpoint that NEVER answered is a config error,
+                    # not a drop: surface it within the retry window
+                    # instead of spinning to the job deadline
+                    # (request_retry_s=0 restores raise-immediately)
+                    if not established and time.time() - start > retry_window:
+                        raise
+                    attempt += 1
+                    time.sleep(_retry_delay(attempt))
+                    continue
+                if resp.status_code in (429, 503) and retry_window > 0:
+                    retry_after = resp.headers.get("Retry-After")
+                    resp.close()
+                    attempt += 1
+                    time.sleep(_retry_delay(attempt, retry_after))
+                    continue
+                try:
+                    # fatal HTTP errors (bad session/payload) raise NOW —
+                    # only drops of an ESTABLISHED stream are resumed
+                    resp.raise_for_status()
+                except requests.HTTPError:
+                    resp.close()
+                    raise
+                established = True
+                try:
+                    for raw in resp.iter_lines():
+                        if not raw:
+                            continue
+                        line = raw.decode() if isinstance(raw, bytes) else raw
+                        if not line.startswith("data: "):
+                            continue
+                        try:
+                            event = json.loads(line[len("data: "):])
+                        except ValueError:
+                            # a torn event (connection died mid-write):
+                            # the stream is about to end — resume path
+                            continue
+                        last = event
+                        attempt = 0  # real progress resets the backoff
+                        if bar is not None:
+                            bar.n = int(_pct(event.get("job_status")))
+                            bar.refresh()
+                        if event.get("job_status") in TERMINAL_STATUSES:
+                            return self._finish_stream(last, timeout)
+                        if time.time() > deadline:
+                            raise TimeoutError(
+                                f"Job {self.job_id} did not complete "
+                                f"within {timeout}s"
+                            )
+                except requests.RequestException:
+                    # stream dropped mid-job: resume by re-POSTing the
+                    # deduped submit instead of raising (the loop)
+                    attempt += 1
+                    time.sleep(_retry_delay(attempt))
+                finally:
+                    resp.close()
+                # a stream that ENDED without a terminal event (graceful
+                # server shutdown mid-job) resumes exactly like a drop —
+                # paced at the SSE tick so a flapping server isn't hammered
+                time.sleep(min(1.0, max(cfg.sse_tick_s, 0.1)))
+            return self._finish_stream(last, timeout)
         finally:
-            resp.close()
             if bar is not None:
                 bar.close()
-        return self._finish_stream(last, timeout)
 
     # ------------- status / results -------------
 
@@ -409,17 +469,67 @@ class MLTaskManager:
     # ------------- REST plumbing -------------
 
     def _request(
-        self, method: str, endpoint: str, json=None, params=None, headers=None
+        self,
+        method: str,
+        endpoint: str,
+        json=None,
+        params=None,
+        headers=None,
+        idempotent: Optional[bool] = None,
     ) -> Dict[str, Any]:
+        """One REST call with transport resilience (docs/ROBUSTNESS.md
+        "Reconnecting edges"): 429/503 responses are retried after their
+        ``Retry-After`` (capped, jittered — the admission-control contract),
+        and connection errors are retried with capped jittered exponential
+        backoff for IDEMPOTENT requests (GETs by default; ``train`` submits
+        opt in because the coordinator dedupes on the client-minted
+        job_id). The retry window is ``service.request_retry_s`` (0
+        disables — every error raises immediately, the legacy behavior)."""
         import requests
 
         url = f"{self.api_url}/{endpoint.lstrip('/')}"
-        resp = requests.request(
-            method, url, json=json_safe(json) if json is not None else None,
-            params=params, headers=headers, timeout=600,
-        )
-        resp.raise_for_status()
-        return resp.json()
+        if idempotent is None:
+            idempotent = method.lower() == "get"
+        retry_window = get_config().service.request_retry_s
+        deadline = time.time() + max(retry_window, 0.0)
+        attempt = 0
+        while True:
+            try:
+                resp = requests.request(
+                    method, url,
+                    json=json_safe(json) if json is not None else None,
+                    params=params, headers=headers, timeout=600,
+                )
+            except (requests.ConnectionError, requests.Timeout):
+                if not idempotent or time.time() >= deadline:
+                    raise
+                attempt += 1
+                time.sleep(_retry_delay(attempt))
+                continue
+            if resp.status_code in (429, 503) and time.time() < deadline:
+                # the request was NOT processed (admission rejection or a
+                # recovering coordinator): safe to retry any method
+                attempt += 1
+                time.sleep(
+                    _retry_delay(attempt, resp.headers.get("Retry-After"))
+                )
+                continue
+            resp.raise_for_status()
+            return resp.json()
+
+
+def _retry_delay(attempt: int, retry_after=None, cap: float = 30.0) -> float:
+    """Capped jittered backoff. A server-sent ``Retry-After`` is the
+    floor (don't come back sooner), padded with up to 25% jitter so a
+    rejected fleet doesn't return in lockstep; otherwise exponential from
+    0.5 s with full jitter."""
+    if retry_after is not None:
+        try:
+            # jitter first, cap last — the cap is a real ceiling
+            return min(float(retry_after) * (1.0 + 0.25 * random.random()), cap)
+        except (TypeError, ValueError):
+            pass
+    return min(10.0, 0.5 * 2 ** min(attempt - 1, 5)) * (0.5 + random.random())
 
 
 def _pct(job_status) -> float:

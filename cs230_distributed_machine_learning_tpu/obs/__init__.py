@@ -166,6 +166,60 @@ def register_catalog() -> None:
         "Duplicate results dropped for subtasks that were speculated "
         "(the losing copy's work)",
     )
+    # ---- coordinator crash recovery + overload survival
+    # (docs/ROBUSTNESS.md "Coordinator recovery") ----
+    g(
+        "tpuml_coordinator_recovery_seconds",
+        "Wall time of the last boot recovery: journal replay plus "
+        "in-flight job re-queue",
+    )
+    c(
+        "tpuml_recovery_replayed_ops_total",
+        "Journal operations replayed at boot, labeled by op",
+    )
+    c(
+        "tpuml_recovery_jobs_resumed_total",
+        "Unfinished jobs re-queued by resume_inflight after a restart",
+    )
+    c(
+        "tpuml_recovery_subtasks_requeued_total",
+        "Subtasks re-dispatched by resume_inflight (no journaled result)",
+    )
+    c(
+        "tpuml_results_duplicate_dropped_total",
+        "Duplicate terminal results dropped at ingest (requeue races, "
+        "speculative losers, zombie attempts from before a restart)",
+    )
+    c(
+        "tpuml_jobs_rejected_total",
+        "Submits rejected by admission control (429), labeled by reason "
+        "(global_inflight|session_inflight|queue_depth)",
+    )
+    c(
+        "tpuml_overload_shed_total",
+        "Optional work shed under overload, labeled by kind "
+        "(speculative|prewarm)",
+    )
+    c(
+        "tpuml_agent_reconnects_total",
+        "Agent re-registrations after a coordinator restart "
+        "(404 on /next_tasks)",
+    )
+    c(
+        "tpuml_agent_results_buffered_total",
+        "Results parked in an agent's local buffer during a coordinator "
+        "outage",
+    )
+    c(
+        "tpuml_agent_results_dropped_total",
+        "Buffered results dropped because the agent's bounded buffer "
+        "overflowed (the subtask re-runs via recovery/lease machinery)",
+    )
+    c(
+        "tpuml_agent_orphan_results_total",
+        "Results ingested from worker ids this coordinator never "
+        "registered (agents flushing buffers across a restart)",
+    )
     c("tpuml_agent_polls_total", "GET /next_tasks long-polls served")
     c(
         "tpuml_agent_tasks_pulled_total",

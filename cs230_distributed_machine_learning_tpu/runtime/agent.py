@@ -25,11 +25,14 @@ parallel/distributed.py has the broadcast/fetch collectives).
 
 from __future__ import annotations
 
+import collections
+import os
+import random
 import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..obs import TRACE_HEADER, Tracer, obs_enabled, span, use_tracer
+from ..obs import TRACE_HEADER, Tracer, counter_inc, obs_enabled, span, use_tracer
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
@@ -78,10 +81,30 @@ class WorkerAgent:
         max_batch: Optional[int] = None,
         register_retries: int = 10,
         register_backoff_s: float = 5.0,
+        result_buffer: Optional[int] = None,
     ):
         self.url = coordinator_url.rstrip("/")
         self.poll_timeout_s = poll_timeout_s
         self._stop = threading.Event()
+        # ---- reconnecting edge (docs/ROBUSTNESS.md "Coordinator
+        # recovery"): a coordinator outage must not lose finished work or
+        # strand this agent. Results that fail to post are parked in a
+        # bounded local buffer (CS230_AGENT_BUFFER, default 256 — oldest
+        # dropped beyond it) and flushed after reconnection; a 404 from
+        # /next_tasks (the restarted coordinator lost the worker registry)
+        # triggers a re-register under a fresh worker id; transient poll
+        # errors back off exponentially with jitter so a reviving
+        # coordinator is not stampeded by its whole fleet at once.
+        self._mem_capacity_mb = mem_capacity_mb
+        self._register_retries = register_retries
+        self._register_backoff_s = register_backoff_s
+        if result_buffer is None:
+            result_buffer = int(os.environ.get("CS230_AGENT_BUFFER", "256") or 256)
+        self._buffer_max = max(int(result_buffer), 0)
+        self._result_buffer: collections.deque = collections.deque()
+        self._buffer_lock = threading.Lock()
+        self._reconnect_lock = threading.Lock()
+        self._poll_failures = 0
         #: prewarm hints shipped in the /subscribe response (the runtime
         #: predictor's hot families bound to recent job shapes); warmed in
         #: the background by start() so the first placed trial finds a
@@ -146,6 +169,10 @@ class WorkerAgent:
         self._stop.set()
         if self._prewarm is not None:
             self._prewarm.stop()
+        if self._result_buffer:
+            # last-chance drain: finished work outlives the agent when the
+            # coordinator is reachable (best-effort, first failure stops)
+            self._flush_results()
         if unsubscribe:
             try:
                 import requests
@@ -178,7 +205,10 @@ class WorkerAgent:
 
     def _poll_tasks(self) -> List[Dict[str, Any]]:
         """One long-poll for this worker's keyed queue; [] on timeout or
-        transient DCN error (backing off inline)."""
+        transient DCN error. A 404 means the coordinator restarted and
+        lost the worker registry — re-register instead of polling a dead
+        id forever; other errors back off with jittered exponential
+        delays (docs/ROBUSTNESS.md "Reconnecting edges")."""
         import requests
 
         try:
@@ -190,12 +220,127 @@ class WorkerAgent:
                 },
                 timeout=self.poll_timeout_s + 10,
             )
+            if resp.status_code == 404:
+                logger.warning(
+                    "Coordinator no longer knows worker %s (restart?); "
+                    "re-registering", self.worker_id,
+                )
+                self._resubscribe()
+                return []
             resp.raise_for_status()
-            return resp.json().get("tasks", [])
+            tasks = resp.json().get("tasks", [])
         except Exception:  # noqa: BLE001
-            logger.exception("Task poll failed; backing off")
-            time.sleep(1.0)
+            self._poll_failures += 1
+            backoff = min(
+                10.0, 0.5 * 2 ** min(self._poll_failures - 1, 5)
+            ) * (0.5 + random.random())
+            logger.warning(
+                "Task poll failed (%d consecutive); backing off %.2fs",
+                self._poll_failures, backoff,
+            )
+            self._stop.wait(backoff)
             return []
+        self._poll_failures = 0
+        if self._result_buffer:
+            # the control plane answered: drain results parked during the
+            # outage before executing anything new
+            self._flush_results()
+        return tasks
+
+    # ---------------- reconnecting edge ----------------
+
+    def _resubscribe(self) -> bool:
+        """Re-register with a restarted coordinator (fresh worker id),
+        then flush the local result buffer under it. Best-effort: a
+        coordinator that vanished again simply leaves the next poll to
+        retry."""
+        with self._reconnect_lock:
+            old = self.worker_id
+            try:
+                wid = self._register(
+                    self._mem_capacity_mb,
+                    self._register_retries,
+                    self._register_backoff_s,
+                )
+            except ConnectionError:
+                logger.error(
+                    "Re-registration with %s failed; will retry on the "
+                    "next poll", self.url,
+                )
+                return False
+            self.worker_id = wid
+            self.executor.executor_id = wid
+            self._poll_failures = 0
+            counter_inc("tpuml_agent_reconnects_total")
+            logger.info(
+                "Re-registered after coordinator restart: %s -> %s", old, wid
+            )
+        self._flush_results()
+        return True
+
+    def _buffer_result(self, stid: str, payload: Dict[str, Any]) -> None:
+        with self._buffer_lock:
+            if self._buffer_max <= 0:
+                counter_inc("tpuml_agent_results_dropped_total")
+                return
+            while len(self._result_buffer) >= self._buffer_max:
+                dropped_stid, _ = self._result_buffer.popleft()
+                counter_inc("tpuml_agent_results_dropped_total")
+                logger.warning(
+                    "Result buffer full (%d); dropping oldest result %s "
+                    "(its subtask will be re-run by the coordinator's "
+                    "recovery/lease machinery)",
+                    self._buffer_max, dropped_stid,
+                )
+            self._result_buffer.append((stid, payload))
+        counter_inc("tpuml_agent_results_buffered_total")
+        logger.warning(
+            "Result post failed for %s; buffered locally (%d pending)",
+            stid, len(self._result_buffer),
+        )
+
+    def _flush_results(self) -> None:
+        """Post buffered results in order; stop at the first failure (the
+        coordinator went away again — keep the rest parked)."""
+        import requests
+
+        while True:
+            with self._buffer_lock:
+                if not self._result_buffer:
+                    return
+                stid, payload = self._result_buffer.popleft()
+            try:
+                resp = requests.post(
+                    f"{self.url}/task_result/{self.worker_id}",
+                    json=payload,
+                    timeout=30,
+                )
+                if (
+                    400 <= resp.status_code < 500
+                    and resp.status_code != 404
+                ):
+                    # permanently rejected (bad payload, coordinator
+                    # without a cluster): drop it rather than wedge the
+                    # whole buffer behind one poison entry — the subtask
+                    # re-runs via the recovery/lease machinery. 404 is
+                    # NOT permanent: the worker id went stale again, and
+                    # the next poll's re-register owns that.
+                    counter_inc("tpuml_agent_results_dropped_total")
+                    logger.error(
+                        "Buffered result %s permanently rejected (%d); "
+                        "dropping it", stid, resp.status_code,
+                    )
+                    continue
+                resp.raise_for_status()
+                logger.info("Flushed buffered result for %s", stid)
+            except Exception:  # noqa: BLE001 — transient: keep the buffer
+                with self._buffer_lock:
+                    self._result_buffer.appendleft((stid, payload))
+                logger.warning(
+                    "Buffered-result flush failed at %s; %d still parked",
+                    stid, len(self._result_buffer),
+                )
+                return
 
     def _run_loop(self) -> None:
         while not self._stop.is_set():
@@ -249,18 +394,23 @@ class WorkerAgent:
 
         from ..obs import process_token
 
+        # obs_pid rides the wire only (popped at ingest): the
+        # coordinator's push_result counts subtask outcomes for REMOTE
+        # processes and must skip an agent sharing its own process,
+        # whose executor already counted into the shared registry
+        payload = {**json_safe(result), "obs_pid": process_token()}
         try:
-            # obs_pid rides the wire only (popped at ingest): the
-            # coordinator's push_result counts subtask outcomes for REMOTE
-            # processes and must skip an agent sharing its own process,
-            # whose executor already counted into the shared registry
-            requests.post(
+            resp = requests.post(
                 f"{self.url}/task_result/{self.worker_id}",
-                json={**json_safe(result), "obs_pid": process_token()},
+                json=payload,
                 timeout=30,
             )
+            resp.raise_for_status()
         except Exception:  # noqa: BLE001
-            logger.exception("Result post failed for %s", stid)
+            # coordinator outage: park the finished work locally — it is
+            # flushed after the next successful poll / re-registration
+            # instead of being lost (at-least-once, deduped at ingest)
+            self._buffer_result(stid, payload)
 
     def _post_metrics(self, msg: Dict[str, Any]) -> None:
         import requests

@@ -283,6 +283,19 @@ class ClusterRuntime:
         src_pid = result.pop("obs_pid", None)
         ok = result.get("status") != "failed"
         result.setdefault("worker_id", worker_id)
+        if worker_id not in self.engine.workers:
+            # a worker this coordinator never registered — typically an
+            # agent flushing its local result buffer across a coordinator
+            # restart, still posting under the pre-crash worker id
+            # (docs/ROBUSTNESS.md "Coordinator recovery"). The result IS
+            # ingested (at-least-once; the job-side attempt dedup owns
+            # duplicates) — only the per-worker books are unknown.
+            counter_inc("tpuml_agent_orphan_results_total")
+            record_event(
+                "result.orphan", job_id=result.get("job_id"),
+                subtask_id=result.get("subtask_id"), worker_id=worker_id,
+                attempt=int(result.get("attempt") or 0),
+            )
         self.engine.record_outcome(worker_id, ok)
         if not ok:
             # failed attempts emit no metrics message: release the engine's

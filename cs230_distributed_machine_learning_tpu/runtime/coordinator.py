@@ -26,6 +26,7 @@ from ..obs import (
     activate,
     counter_inc,
     current_trace_id,
+    gauge_set,
     new_trace_id,
     record_event,
     span,
@@ -76,14 +77,65 @@ class Coordinator:
         self.executor = executor or LocalExecutor(mesh=mesh, cache=self.cache)
         self._job_threads: Dict[str, threading.Thread] = {}
         self._artifact_lock = threading.Lock()
-        self._artifact_specs: Dict[Any, Dict[str, Any]] = {}
         self._artifact_paths: Dict[Any, str] = {}
+        self._artifact_specs: Dict[Any, Dict[str, Any]] = {}
+        #: submit-dedupe guard: job_ids currently being expanded, so a
+        #: retried duplicate POST arriving DURING expansion (the store
+        #: doesn't know the job yet) can't double-expand
+        self._submit_lock = threading.Lock()
+        self._submitting: set = set()
+        #: readiness (GET /readyz): False while the journal is being
+        #: replayed / in-flight jobs re-queued, so load balancers and the
+        #: chaos harness can gate on recovery completion
+        self.ready = not journal
+        #: recovery forensics for /healthz (replayed-op counts, wall time)
+        self.recovery: Dict[str, Any] = {}
         if cluster is not None:
             # journal every attempt issue (lease reclaim / retry / requeue /
-            # speculation) into the job store so replay preserves budgets
+            # speculation) into the job store so replay preserves budgets,
+            # and every placement/lease grant so a restarted coordinator
+            # can tell dispatched in-flight subtasks from never-dispatched
+            # ones (docs/ROBUSTNESS.md "Coordinator recovery")
             cluster.ledger.on_attempt = self._journal_attempt
+            cluster.engine.on_place = self._journal_placement
+            # overload probe: speculation sheds first under load
+            cluster.engine.shed_check = self.overload_shedding
         if journal:
-            self.resume_inflight()
+            self._recover()
+
+    def _recover(self) -> None:
+        """Boot-time crash recovery: surface the journal replay the store
+        already ran, re-queue in-flight work, and flip readiness. The
+        whole sequence is synchronous — a coordinator is never serving
+        while half-recovered."""
+        t0 = time.time()
+        for op, n in self.store.replay_ops.items():
+            counter_inc("tpuml_recovery_replayed_ops_total", n, op=op)
+        record_event(
+            "recovery.start",
+            replayed_ops=sum(self.store.replay_ops.values()),
+            replay_skipped=self.store.replay_skipped,
+            replay_seconds=round(self.store.replay_seconds, 6),
+        )
+        resumed = self.resume_inflight()
+        recovery_s = self.store.replay_seconds + (time.time() - t0)
+        self.recovery = {
+            "replayed_ops": dict(self.store.replay_ops),
+            "replay_skipped": self.store.replay_skipped,
+            "jobs_resumed": len(resumed),
+            "subtasks_requeued": self._resume_requeued,
+            "recovery_seconds": recovery_s,
+        }
+        gauge_set("tpuml_coordinator_recovery_seconds", recovery_s)
+        record_event("recovery.done", **self.recovery)
+        if resumed:
+            logger.info(
+                "Recovery done in %.3fs: %d ops replayed, %d jobs resumed, "
+                "%d subtasks re-queued",
+                recovery_s, sum(self.store.replay_ops.values()),
+                len(resumed), self._resume_requeued,
+            )
+        self.ready = True
 
     def _journal_attempt(self, task: Dict[str, Any], entry, reason: str) -> None:
         sid = task.get("session_id")
@@ -103,14 +155,39 @@ class Coordinator:
             # cluster): nothing to journal
             pass
 
+    def _journal_placement(self, task: Dict[str, Any], worker_id: str,
+                           lease_deadline=None) -> None:
+        sid = task.get("session_id")
+        jid = task.get("job_id")
+        stid = task.get("subtask_id")
+        if not (sid and jid and stid):
+            return
+        try:
+            self.store.record_placement(
+                sid, jid, stid, worker_id,
+                attempt=int(task.get("attempt") or 0),
+                lease_deadline=lease_deadline,
+            )
+        except KeyError:
+            pass  # foreign traffic on a shared cluster: nothing to journal
+
+    #: subtasks re-dispatched by the most recent resume_inflight()
+    _resume_requeued = 0
+
     def resume_inflight(self) -> List[str]:
         """Re-dispatch jobs the journal shows as unfinished: replay restores
         state, this restores WORK — a coordinator killed mid-job completes it
         after restart without client resubmission (beyond the reference,
         whose master restart loses in-flight jobs; Redis AOF only kept
         state, SURVEY.md §5.4). Subtasks with a journaled terminal result
-        are not re-run."""
+        are not re-run. In cluster mode, subtasks the journal shows as
+        PLACED pre-crash get a fresh attempt id before re-queueing: a
+        zombie worker's late FAILED report then carries a superseded stamp
+        and cannot burn retry budget, while its late COMPLETED report is
+        still accepted (first terminal result wins — the at-least-once
+        re-ingest contract, docs/ROBUSTNESS.md)."""
         resumed = []
+        self._resume_requeued = 0
         for sid, job_id in self.store.unfinished_jobs():
             job = self.store.get_job(sid, job_id)
             specs = [sub["spec"] for sub in job["subtasks"].values()]
@@ -119,10 +196,28 @@ class Coordinator:
                 for stid, sub in job["subtasks"].items()
                 if sub["status"] in ("completed", "failed") and sub["result"]
             }
+            remaining = [
+                st for st in specs if st["subtask_id"] not in existing
+            ]
+            if self.cluster is not None:
+                for st in remaining:
+                    if st.get("placed_worker") is None:
+                        continue  # never dispatched (or pre-place journal)
+                    self.cluster.ledger.seed(st)
+                    self.cluster.ledger.next_attempt(st, reason="recovery")
             logger.info(
                 "Resuming job %s: %d/%d subtasks already journaled",
                 job_id, len(existing), len(specs),
             )
+            record_event(
+                "job.resume", job_id=job_id,
+                n_done=len(existing), n_requeued=len(remaining),
+            )
+            counter_inc("tpuml_recovery_jobs_resumed_total")
+            counter_inc(
+                "tpuml_recovery_subtasks_requeued_total", len(remaining)
+            )
+            self._resume_requeued += len(remaining)
             t = threading.Thread(
                 target=self._run_job,
                 args=(sid, job_id, specs),
@@ -133,6 +228,71 @@ class Coordinator:
             t.start()
             resumed.append(job_id)
         return resumed
+
+    # ------------- admission control (docs/ROBUSTNESS.md "Overload") -------------
+
+    def admission_check(self, sid: Optional[str] = None) -> Optional[Dict[str, Any]]:
+        """Admission decision for one would-be submit. None = admitted.
+        Otherwise a rejection dict {reason, retry_after_s, status} the
+        server maps to 429 (+ Retry-After) — or 503 while recovering.
+        Caps (``service`` config): global / per-session in-flight job
+        counts and the pending-subtask queue-depth watermark."""
+        svc = self.config.service
+        if not self.ready:
+            return {
+                "reason": "recovering",
+                "retry_after_s": svc.admission_retry_after_s,
+                "status": 503,
+            }
+        counts = self.store.unfinished_counts()
+        reason = None
+        if 0 < svc.max_inflight_jobs <= counts["jobs"]:
+            reason = "global_inflight"
+        elif (
+            sid is not None
+            and 0 < svc.max_inflight_jobs_per_session
+            <= counts["per_session"].get(sid, 0)
+        ):
+            reason = "session_inflight"
+        elif 0 < svc.admission_queue_watermark <= counts["pending_subtasks"]:
+            reason = "queue_depth"
+        if reason is None:
+            return None
+        counter_inc("tpuml_jobs_rejected_total", reason=reason)
+        record_event(
+            "admission.reject", reason=reason, session_id=sid,
+            inflight_jobs=counts["jobs"],
+            pending_subtasks=counts["pending_subtasks"],
+        )
+        logger.warning(
+            "Rejecting submit for session %s: %s (%d jobs in flight, "
+            "%d subtasks pending)", sid, reason, counts["jobs"],
+            counts["pending_subtasks"],
+        )
+        return {
+            "reason": reason,
+            "retry_after_s": svc.admission_retry_after_s,
+            "status": 429,
+        }
+
+    def overload_shedding(self) -> bool:
+        """True while accepted load sits above ``shed_fraction`` of any
+        enabled admission cap — the graceful-degradation band where the
+        engine sheds OPTIONAL work (speculative duplicates, prewarm hints)
+        before admission starts rejecting submits."""
+        svc = self.config.service
+        frac = svc.shed_fraction
+        if frac <= 0:
+            return False
+        counts = self.store.unfinished_counts()
+        if svc.max_inflight_jobs > 0 and (
+            counts["jobs"] >= frac * svc.max_inflight_jobs
+        ):
+            return True
+        return svc.admission_queue_watermark > 0 and (
+            counts["pending_subtasks"]
+            >= frac * svc.admission_queue_watermark
+        )
 
     # ------------- session / data management (master.py:56-112 parity) -------------
 
@@ -197,6 +357,45 @@ class Coordinator:
         {job_id?, dataset_id, model_details, train_params}."""
         self._require_session(sid)
         job_id = payload.get("job_id") or str(uuid.uuid4())
+        if payload.get("job_id"):
+            # idempotent resubmit: the client minted this job_id and is
+            # retrying a submit whose response it never saw (coordinator
+            # restart, dropped SSE stream, 429 backoff loop). Re-expanding
+            # would duplicate every subtask — return the original
+            # acceptance instead (docs/ROBUSTNESS.md "Reconnecting edges").
+            # The check and the in-progress claim happen under one lock:
+            # a duplicate arriving DURING the first copy's expansion (the
+            # store doesn't know the job yet) must dedupe too, not race
+            # has_job-then-create.
+            with self._submit_lock:
+                known = self.store.has_job(sid, job_id)
+                if known or job_id in self._submitting:
+                    logger.info("Duplicate submit of job %s deduped", job_id)
+                    return {
+                        "status": "submitted",
+                        "job_id": job_id,
+                        # unknown while the first copy is still expanding
+                        "total_subtasks": (
+                            self.store.job_progress(sid, job_id)[
+                                "total_subtasks"
+                            ] if known else None
+                        ),
+                        "duplicate": True,
+                    }
+                self._submitting.add(job_id)
+            try:
+                return self._submit_train_locked(sid, job_id, payload)
+            finally:
+                with self._submit_lock:
+                    self._submitting.discard(job_id)
+        return self._submit_train_locked(sid, job_id, payload)
+
+    def _submit_train_locked(
+        self, sid: str, job_id: str, payload: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """Expansion + persistence + dispatch for an admitted, deduped
+        submit (``_submitting`` guard held by the caller for client-minted
+        job ids)."""
         dataset_id = payload["dataset_id"]
         model_details = payload["model_details"]
         train_params = dict(payload.get("train_params") or {})
@@ -401,9 +600,16 @@ class Coordinator:
                     continue
                 result = result or {}
                 if stid not in pending:
-                    # duplicate delivery: a requeue race or the losing copy
-                    # of a speculative pair — dropped here, which IS the
-                    # cancellation ("first terminal result wins")
+                    # duplicate delivery: a requeue race, the losing copy
+                    # of a speculative pair, or a zombie attempt from
+                    # before a coordinator restart — dropped here, which
+                    # IS the cancellation ("first terminal result wins")
+                    counter_inc("tpuml_results_duplicate_dropped_total")
+                    record_event(
+                        "result.duplicate", job_id=job_id, subtask_id=stid,
+                        worker_id=result.get("worker_id"),
+                        attempt=int(result.get("attempt") or 0),
+                    )
                     if ledger.was_speculated(stid):
                         counter_inc("tpuml_speculative_wasted_total")
                         record_event(
@@ -746,6 +952,12 @@ class Coordinator:
         from .prewarm import max_hints
 
         if not prewarm_enabled():
+            return []
+        if self.overload_shedding():
+            # graceful degradation: an overloaded fleet must not spend
+            # idle-window device time warming SPECULATIVE shapes — shed
+            # prewarm before admission starts rejecting real submits
+            counter_inc("tpuml_overload_shed_total", kind="prewarm")
             return []
         limit = limit if limit is not None else max_hints()
         if limit <= 0:

@@ -47,6 +47,23 @@ class TopicBus:
                 delivered += 1
         return delivered
 
+    def depth(self, topic: str) -> int:
+        """Undelivered messages parked on the topic's subscriber queues —
+        an overload signal (`GET /healthz` bus_depths): a deep `train`
+        backlog means placements are outrunning the executors."""
+        with self._lock:
+            subs = list(self._subs.get(topic, []))
+        return sum(len(s) for s in subs)
+
+    def depths(self) -> Dict[str, int]:
+        # one lock hold: a consistent cross-topic snapshot, not N+1
+        # acquisitions contending with the publish path
+        with self._lock:
+            return {
+                t: sum(len(s) for s in subs)
+                for t, subs in self._subs.items()
+            }
+
 
 class Subscription:
     def __init__(self, bus: TopicBus, topic: str, key_filter) -> None:

@@ -135,6 +135,18 @@ class PlacementEngine:
         #: called with a worker id the breaker evicted — the cluster hooks
         #: this to tear down the in-process worker / remote subscription
         self.on_evict: Optional[Callable[[str], None]] = None
+        #: called AFTER a placement with (task, worker_id, lease_deadline)
+        #: — the coordinator hooks this to journal placements + lease
+        #: grants so a restarted process can tell dispatched in-flight
+        #: subtasks from never-dispatched ones (docs/ROBUSTNESS.md
+        #: "Coordinator recovery")
+        self.on_place: Optional[
+            Callable[[Dict[str, Any], str, Optional[float]], None]
+        ] = None
+        #: overload probe installed by the coordinator (admission control):
+        #: True while the fleet is shedding optional work — speculation
+        #: skips its launches first, before admission starts rejecting
+        self.shed_check: Optional[Callable[[], bool]] = None
         self._lock = threading.RLock()
         self.workers: Dict[str, WorkerState] = {}
         self._next_id = 0
@@ -630,6 +642,14 @@ class PlacementEngine:
                       subtask_id=stid, worker=wid, est_runtime_s=est,
                       attempt=attempt) as sp:
                 sp.start = time.time() - elapsed
+        hook = self.on_place
+        if hook is not None:
+            try:
+                hook(task, wid, lease_deadline)
+            except Exception:  # noqa: BLE001 — journaling must not kill dispatch
+                logger.exception(
+                    "Placement journal hook failed for %s", stid
+                )
         if self.bus is not None:
             self.bus.publish(TOPIC_TRAIN, task, key=wid)
         return wid
@@ -835,6 +855,18 @@ class PlacementEngine:
         cfg = self.cfg
         if not cfg.speculative_enabled:
             return []
+        shed = self.shed_check
+        if shed is not None:
+            try:
+                overloaded = bool(shed())
+            except Exception:  # noqa: BLE001 — the probe must not kill the sweep
+                overloaded = False
+            if overloaded:
+                # graceful degradation (docs/ROBUSTNESS.md "Admission
+                # control"): under overload the OPTIONAL duplicate work
+                # goes first — capacity serves admitted jobs, not hedges
+                counter_inc("tpuml_overload_shed_total", kind="speculative")
+                return []
         now = time.time()
         launches: List[tuple] = []  # (owner_wid, task copy)
         with self._lock:

@@ -271,6 +271,12 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/trace_spans/<wid>", endpoint="trace_spans", methods=["POST"]),
             Rule("/cost/<jid>", endpoint="cost", methods=["GET"]),
             Rule("/healthz", endpoint="healthz", methods=["GET"]),
+            # liveness/readiness split (docs/ROBUSTNESS.md "Coordinator
+            # recovery"): /livez answers as long as the process serves;
+            # /readyz is 503 until journal replay + in-flight re-queue
+            # finished, so load balancers and the chaos harness can gate
+            Rule("/livez", endpoint="livez", methods=["GET"]),
+            Rule("/readyz", endpoint="readyz", methods=["GET"]),
             # flight recorder + explainability (docs/OBSERVABILITY.md
             # "Flight recorder"): per-subtask decision timelines, the
             # event firehose, predictor calibration, and the embedded
@@ -341,6 +347,8 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /predictor/calibration  (predicted-vs-actual stats)",
                     "GET  /health",
                     "GET  /healthz  (deep health: device, workers, stragglers)",
+                    "GET  /livez  (liveness probe)",
+                    "GET  /readyz  (readiness: 503 while recovering)",
                 ],
             }
         )
@@ -377,11 +385,43 @@ def create_app(coordinator: Optional[Coordinator] = None):
         body = request.get_json(force=True)
         return _json(coord.preprocess(sid, body["dataset_id"], body.get("config")))
 
+    def _admission_reject(sid):
+        """429/503 + Retry-After for a submit the coordinator must not
+        accept (admission caps, or recovery still in progress) — the
+        overload contract of docs/ROBUSTNESS.md. None when admitted."""
+        rejection = coord.admission_check(sid)
+        if rejection is None:
+            return None
+        return Response(
+            json.dumps(json_safe({
+                "status": "rejected",
+                "reason": rejection["reason"],
+                "retry_after_s": rejection["retry_after_s"],
+            })),
+            status=rejection["status"],
+            mimetype="application/json",
+            headers={"Retry-After": f"{rejection['retry_after_s']:g}"},
+        )
+
     def train(request, sid):
+        reject = _admission_reject(sid)
+        if reject is not None:
+            return reject
         return _json(coord.submit_train(sid, request.get_json(force=True)))
 
     def train_status(request, sid):
-        submit = coord.submit_train(sid, request.get_json(force=True))
+        body = request.get_json(force=True)
+        # an SSE RESUME (known job_id) is a read, not new load — it must
+        # never be rejected, or a reconnecting client could not follow the
+        # job it already owns through the very overload that dropped it
+        known = bool(
+            body.get("job_id") and coord.store.has_job(sid, body["job_id"])
+        )
+        if not known:
+            reject = _admission_reject(sid)
+            if reject is not None:
+                return reject
+        submit = coord.submit_train(sid, body)
         job_id = submit["job_id"]
 
         def stream():
@@ -439,8 +479,17 @@ def create_app(coordinator: Optional[Coordinator] = None):
         """Deep health, beyond /health's liveness ping: local device
         reachability + memory, per-worker health (EWMA batch latency,
         heartbeat age, failure ratio, queue depth), and the flagged
-        straggler list. Always HTTP 200; ``status`` says ok/degraded."""
-        out = {"status": "ok", "obs_enabled": obs_enabled()}
+        straggler list. Always HTTP 200; ``status`` says ok/degraded.
+        ``ready``/``recovery`` mirror /readyz (journal replay state)."""
+        out = {
+            "status": "ok",
+            "obs_enabled": obs_enabled(),
+            "ready": coord.ready,
+        }
+        if coord.recovery:
+            out["recovery"] = coord.recovery
+        if not coord.ready:
+            out["status"] = "degraded"
         try:
             import jax
 
@@ -469,6 +518,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
             snap = coord.cluster.engine.refresh_health_metrics()
             out["n_workers"] = len(snap)
             out["workers"] = snap
+            # undelivered bus backlog per topic: a deep `train` backlog
+            # means placements are outrunning the executor pool
+            out["bus_depths"] = coord.cluster.bus.depths()
             out["queue_depths"] = {
                 wid: h["queue_depth"] for wid, h in snap.items()
             }
@@ -488,6 +540,30 @@ def create_app(coordinator: Optional[Coordinator] = None):
             if slots and out["agent_slots"]["gave_up"] == len(slots):
                 out["status"] = "degraded"
         return _json(out)
+
+    def livez(request):
+        """Pure liveness: the process answers requests. Never inspects
+        recovery, workers, or devices — a recovering or degraded
+        coordinator is still ALIVE (restarting it would only lose the
+        recovery progress)."""
+        return _json({"status": "ok"})
+
+    def readyz(request):
+        """Readiness: 200 only once journal replay + in-flight re-queue
+        finished (``Coordinator.ready``). 503 + Retry-After while
+        recovering, so load balancers hold traffic and the chaos harness
+        can gate on recovery completion."""
+        if coord.ready:
+            return _json({"status": "ready", "recovery": coord.recovery})
+        retry_after = coord.config.service.admission_retry_after_s
+        return Response(
+            json.dumps(json_safe({
+                "status": "recovering", "recovery": coord.recovery,
+            })),
+            status=503,
+            mimetype="application/json",
+            headers={"Retry-After": f"{retry_after:g}"},
+        )
 
     def explain(request, jid, stid):
         """Per-subtask decision timeline from the flight recorder: who

@@ -49,6 +49,11 @@ class JobStore:
         self._sessions: Dict[str, Dict[str, Any]] = {}
         self._done_events: Dict[tuple, threading.Event] = {}
         self._journal_path = None
+        #: replay forensics, read by the coordinator's recovery metrics
+        #: (tpuml_recovery_replayed_ops_total{op=}) and GET /healthz
+        self.replay_ops: Dict[str, int] = {}
+        self.replay_skipped = 0
+        self.replay_seconds = 0.0
         if journal_dir:
             os.makedirs(journal_dir, exist_ok=True)
             self._journal_path = os.path.join(journal_dir, "jobs.jsonl")
@@ -173,6 +178,10 @@ class JobStore:
             sub["status"] = status
             if result is not None:
                 sub["result"] = json_safe(result)
+                # the attempt that delivered the accepted result — the
+                # result-ack half of the at-least-once contract: a replayed
+                # coordinator knows which attempt is already delivered
+                sub["attempt"] = int((result or {}).get("attempt") or 0)
             if status in ("completed", "failed") and prev not in ("completed", "failed"):
                 if status == "completed":
                     job["completed_subtasks"] += 1
@@ -189,6 +198,7 @@ class JobStore:
                 "jid": job_id,
                 "stid": subtask_id,
                 "status": status,
+                "attempt": int((result or {}).get("attempt") or 0),
                 "result": json_safe(result),
             }
         )
@@ -223,6 +233,67 @@ class JobStore:
                 "excluded": list(excluded or []),
             }
         )
+
+    def record_placement(
+        self,
+        sid: str,
+        job_id: str,
+        subtask_id: str,
+        worker_id: str,
+        attempt: int = 0,
+        lease_deadline: Optional[float] = None,
+    ) -> None:
+        """Journal a placement (and its lease grant, when leases are on)
+        into the spec. A replayed coordinator can then tell dispatched
+        in-flight subtasks (bump the attempt before re-queueing, so a
+        zombie worker's late FAILED report is stale by construction) from
+        never-dispatched ones, instead of re-issuing attempt 0 blind."""
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            spec = job["subtasks"][subtask_id]["spec"]
+            spec["placed_worker"] = worker_id
+            spec["placed_attempt"] = int(attempt or 0)
+            if lease_deadline is not None:
+                spec["lease_deadline"] = float(lease_deadline)
+        self._journal(
+            {
+                "op": "place",
+                "sid": sid,
+                "jid": job_id,
+                "stid": subtask_id,
+                "worker": worker_id,
+                "attempt": int(attempt or 0),
+                "lease_deadline": lease_deadline,
+            }
+        )
+
+    def has_job(self, sid: str, job_id: str) -> bool:
+        with self._lock:
+            sess = self._sessions.get(sid)
+            return bool(sess and job_id in sess["jobs"])
+
+    def unfinished_counts(self) -> Dict[str, Any]:
+        """Admission-control inputs in one lock hold: unfinished job count
+        (global + per session) and the total PENDING subtasks across those
+        jobs — the queue-depth watermark input (docs/ROBUSTNESS.md
+        "Admission control")."""
+        per_session: Dict[str, int] = {}
+        jobs = 0
+        pending = 0
+        with self._lock:
+            for sid, sess in self._sessions.items():
+                for job in sess["jobs"].values():
+                    if job["status"] in TERMINAL_STATUSES:
+                        continue
+                    jobs += 1
+                    per_session[sid] = per_session.get(sid, 0) + 1
+                    done = job["completed_subtasks"] + job["failed_subtasks"]
+                    pending += max(int(job["total_subtasks"]) - done, 0)
+        return {
+            "jobs": jobs,
+            "per_session": per_session,
+            "pending_subtasks": pending,
+        }
 
     def finalize_job(self, sid: str, job_id: str, result: Dict[str, Any]) -> None:
         status = _final_status(result)
@@ -326,67 +397,103 @@ class JobStore:
     def _replay(self) -> None:
         if not (self._journal_path and os.path.exists(self._journal_path)):
             return
+        t0 = time.time()
+        ends_with_newline = True
         with open(self._journal_path) as f:
             for line in f:
+                ends_with_newline = line.endswith("\n")
                 line = line.strip()
                 if not line:
                     continue
                 try:
                     e = json.loads(line)
                 except json.JSONDecodeError:
+                    # a torn write (the process died mid-append) or bitrot:
+                    # skip the line — losing ONE op beats losing the store
+                    self.replay_skipped += 1
                     continue
-                op = e.get("op")
-                if op == "create_session":
-                    self._sessions.setdefault(
-                        e["sid"], {"created_at": time.time(), "jobs": {}}
+                if self._apply_entry(e):
+                    op = str(e.get("op"))
+                    self.replay_ops[op] = self.replay_ops.get(op, 0) + 1
+                else:
+                    self.replay_skipped += 1
+        if not ends_with_newline:
+            # torn-tail repair: the journal died mid-line. Terminate the
+            # torn line NOW so the next append starts clean — otherwise the
+            # first post-recovery op would concatenate onto the torn bytes
+            # and BOTH would be lost at the next replay (pinned in
+            # tests/test_durability.py).
+            try:
+                with self._lock:
+                    with open(self._journal_path, "a") as f:
+                        f.write("\n")
+            except OSError:
+                pass
+        self.replay_seconds = time.time() - t0
+
+    def _apply_entry(self, e: Dict[str, Any]) -> bool:
+        """Apply one journal entry to in-memory state; False when the entry
+        is unknown or references state the (possibly truncated) journal
+        never created. Every branch is total — replay NEVER raises, no
+        matter where a crash truncated the journal (the crash-point fuzz
+        test in tests/test_durability.py cuts at every op boundary)."""
+        op = e.get("op")
+        try:
+            if op == "create_session":
+                self._sessions.setdefault(
+                    e["sid"], {"created_at": time.time(), "jobs": {}}
+                )
+            elif op == "create_job":
+                self._sessions.setdefault(
+                    e["sid"], {"created_at": time.time(), "jobs": {}}
+                )["jobs"][e["record"]["job_id"]] = e["record"]
+            elif op == "update_subtask":
+                job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                sub = job["subtasks"][e["stid"]]
+                prev = sub["status"]
+                sub["status"] = e["status"]
+                if e.get("result") is not None:
+                    sub["result"] = e["result"]
+                    sub["attempt"] = int(e.get("attempt", 0) or 0)
+                if e["status"] in ("completed", "failed") and prev not in (
+                    "completed",
+                    "failed",
+                ):
+                    key = (
+                        "completed_subtasks"
+                        if e["status"] == "completed"
+                        else "failed_subtasks"
                     )
-                elif op == "create_job":
-                    self._sessions.setdefault(
-                        e["sid"], {"created_at": time.time(), "jobs": {}}
-                    )["jobs"][e["record"]["job_id"]] = e["record"]
-                elif op == "update_subtask":
-                    try:
-                        job = self._sessions[e["sid"]]["jobs"][e["jid"]]
-                        sub = job["subtasks"][e["stid"]]
-                        prev = sub["status"]
-                        sub["status"] = e["status"]
-                        if e.get("result") is not None:
-                            sub["result"] = e["result"]
-                        if e["status"] in ("completed", "failed") and prev not in (
-                            "completed",
-                            "failed",
-                        ):
-                            key = (
-                                "completed_subtasks"
-                                if e["status"] == "completed"
-                                else "failed_subtasks"
-                            )
-                            job[key] += 1
-                    except KeyError:
-                        continue
-                elif op == "subtask_attempt":
-                    # fault-tolerance bookkeeping (docs/ROBUSTNESS.md):
-                    # restore retry budgets / excluded-worker memory into
-                    # the spec. Journals that predate the attempt schema
-                    # simply have no such ops — every reader of the fields
-                    # defaults to a zeroed budget (.get(..., 0)), the same
-                    # fallback style as completion_time below.
-                    try:
-                        job = self._sessions[e["sid"]]["jobs"][e["jid"]]
-                        spec = job["subtasks"][e["stid"]]["spec"]
-                        spec["attempt"] = int(e.get("attempt", 0) or 0)
-                        spec["failures"] = int(e.get("failures", 0) or 0)
-                        spec["excluded_workers"] = list(e.get("excluded") or [])
-                    except KeyError:
-                        continue
-                elif op == "finalize_job":
-                    try:
-                        job = self._sessions[e["sid"]]["jobs"][e["jid"]]
-                        job["result"] = e["result"]
-                        job["status"] = _final_status(e["result"])
-                        # older journals predate the field: fall back to
-                        # the entry's absence rather than losing the job
-                        if e.get("completion_time") is not None:
-                            job["completion_time"] = e["completion_time"]
-                    except KeyError:
-                        continue
+                    job[key] += 1
+            elif op == "subtask_attempt":
+                # fault-tolerance bookkeeping (docs/ROBUSTNESS.md):
+                # restore retry budgets / excluded-worker memory into
+                # the spec. Journals that predate the attempt schema
+                # simply have no such ops — every reader of the fields
+                # defaults to a zeroed budget (.get(..., 0)), the same
+                # fallback style as completion_time below.
+                job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                spec = job["subtasks"][e["stid"]]["spec"]
+                spec["attempt"] = int(e.get("attempt", 0) or 0)
+                spec["failures"] = int(e.get("failures", 0) or 0)
+                spec["excluded_workers"] = list(e.get("excluded") or [])
+            elif op == "place":
+                job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                spec = job["subtasks"][e["stid"]]["spec"]
+                spec["placed_worker"] = e.get("worker")
+                spec["placed_attempt"] = int(e.get("attempt", 0) or 0)
+                if e.get("lease_deadline") is not None:
+                    spec["lease_deadline"] = float(e["lease_deadline"])
+            elif op == "finalize_job":
+                job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                job["result"] = e["result"]
+                job["status"] = _final_status(e["result"])
+                # older journals predate the field: fall back to
+                # the entry's absence rather than losing the job
+                if e.get("completion_time") is not None:
+                    job["completion_time"] = e["completion_time"]
+            else:
+                return False
+        except (KeyError, TypeError, ValueError):
+            return False
+        return True

@@ -134,6 +134,26 @@ class ServiceConfig:
     sse_tick_s: float = 1.5
     client_poll_s: float = 1.0
     client_timeout_s: float = 600.0  # reference default of 60 s is too small
+    # ---- admission control / overload survival (docs/ROBUSTNESS.md
+    # "Coordinator recovery and overload survival") ----
+    # hard caps on ACCEPTED work: a submit beyond any of them is rejected
+    # with 429 + Retry-After instead of queueing the coordinator to death.
+    # <= 0 disables the corresponding cap.
+    max_inflight_jobs: int = 64
+    max_inflight_jobs_per_session: int = 16
+    # total PENDING subtasks across all unfinished jobs — the queue-depth
+    # watermark (a 10-trial job and a 10k-trial job are not the same load)
+    admission_queue_watermark: int = 50000
+    # Retry-After seconds sent with 429 (admission) and 503 (recovering)
+    admission_retry_after_s: float = 5.0
+    # soft watermark: above this fraction of any enabled cap the engine
+    # sheds optional work first (speculative duplicates, prewarm hints)
+    # before admission starts rejecting
+    shed_fraction: float = 0.8
+    # client-side transport resilience: how long MLTaskManager keeps
+    # retrying an idempotent request through 429/503/connection errors
+    # (capped jittered backoff, Retry-After honored). 0 disables retries.
+    request_retry_s: float = 60.0
 
 
 @dataclasses.dataclass

@@ -25,8 +25,13 @@
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
-#   reclaim, SPMD host loss, supervisor restart policy — which the fast
-#   gate never runs.
+#   reclaim, the coordinator-SIGKILL drill (server subprocess killed
+#   mid-job + restarted against the same journal dir; agents reconnect
+#   and flush buffers — docs/ROBUSTNESS.md "Coordinator recovery"),
+#   SPMD host loss, supervisor restart policy — which the fast gate
+#   never runs. The drill writes its journal dir + process logs under
+#   $CI_ARTIFACTS_DIR/coordinator_kill, so a red run uploads the
+#   coordinator's jobs.jsonl and flight-recorder events.jsonl.
 #
 # On a RED suite the trace/metric/decision record of the run is preserved
 # under $CI_ARTIFACTS_DIR (default ci-artifacts/) so failures are

@@ -111,6 +111,133 @@ def test_wait_job_is_event_driven():
         t.cancel()
 
 
+def _rich_journal(jd: str) -> str:
+    """Write a journal exercising EVERY op type: session, job, placement
+    (+lease), result acks (completed and failed), an attempt bump, and the
+    finalize. Returns the session id."""
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    subtasks = [{"subtask_id": f"f-subtask-{i}"} for i in range(3)]
+    store.create_job(sid, "f", {"dataset_id": "iris"}, subtasks)
+    store.record_placement(
+        sid, "f", "f-subtask-0", "worker-0", attempt=0, lease_deadline=123.5
+    )
+    store.update_subtask(
+        sid, "f", "f-subtask-0", "completed",
+        {"mean_cv_score": 0.9, "attempt": 0},
+    )
+    store.record_attempt(
+        sid, "f", "f-subtask-1", attempt=1, failures=1, excluded=["worker-0"]
+    )
+    store.record_placement(sid, "f", "f-subtask-1", "worker-1", attempt=1)
+    store.update_subtask(
+        sid, "f", "f-subtask-1", "failed", {"error": "boom", "attempt": 1}
+    )
+    store.update_subtask(
+        sid, "f", "f-subtask-2", "completed", {"mean_cv_score": 0.8}
+    )
+    store.finalize_job(sid, "f", {"results": [], "best_result": None})
+    return sid
+
+
+def test_journal_crash_point_fuzz(tmp_path):
+    """Replay must never raise no matter where a crash truncated the
+    journal, and the truncated store must accept the remaining suffix:
+    appending the rest of the ops and replaying again reproduces the full
+    state (the coordinator-crash recovery contract, docs/ROBUSTNESS.md
+    "Coordinator recovery")."""
+    jd_full = str(tmp_path / "full")
+    sid = _rich_journal(jd_full)
+    raw = open(os.path.join(jd_full, "jobs.jsonl"), "rb").read()
+    lines = raw.splitlines(keepends=True)
+    assert len(lines) >= 8  # every op type is present
+    want = JobStore(journal_dir=jd_full).job_progress(sid, "f")
+
+    for i in range(len(lines) + 1):
+        jd = str(tmp_path / f"cut{i}")
+        os.makedirs(jd)
+        path = os.path.join(jd, "jobs.jsonl")
+        with open(path, "wb") as f:
+            f.writelines(lines[:i])
+        cut = JobStore(journal_dir=jd)  # must never raise
+        assert cut.replay_skipped == 0
+        # the suffix (ordered after the prefix, so every reference it
+        # makes was created earlier) must apply cleanly on top
+        with open(path, "ab") as f:
+            f.writelines(lines[i:])
+        resumed = JobStore(journal_dir=jd)
+        assert resumed.job_progress(sid, "f") == want
+
+
+def test_journal_torn_write_repaired(tmp_path):
+    """A crash mid-append leaves a torn (non-JSON, unterminated) final
+    line: replay skips it, repairs the tail with a newline, and ops
+    appended after recovery survive the NEXT replay instead of
+    concatenating onto the torn bytes."""
+    jd_full = str(tmp_path / "full")
+    _rich_journal(jd_full)
+    raw = open(os.path.join(jd_full, "jobs.jsonl"), "rb").read()
+    lines = raw.splitlines(keepends=True)
+
+    jd = str(tmp_path / "torn")
+    os.makedirs(jd)
+    path = os.path.join(jd, "jobs.jsonl")
+    with open(path, "wb") as f:
+        f.writelines(lines[:3])
+        f.write(lines[3][: len(lines[3]) // 2])  # torn mid-line, no \n
+    store = JobStore(journal_dir=jd)  # must not raise
+    assert store.replay_skipped == 1
+    assert store.replay_ops.get("create_job") == 1
+    # post-recovery append starts on a clean line (tail repair)
+    sid2 = store.create_session()
+    third = JobStore(journal_dir=jd)
+    assert third.has_session(sid2)
+    assert third.replay_skipped == 1  # still just the one torn line
+
+
+def test_placement_journal_replayed(tmp_path):
+    """The `place` op restores placed_worker/placed_attempt/lease_deadline
+    into the spec — how a restarted coordinator tells dispatched in-flight
+    subtasks from never-dispatched ones."""
+    jd = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(
+        sid, "p", {}, [{"subtask_id": "p-subtask-0"}, {"subtask_id": "p-subtask-1"}]
+    )
+    store.record_placement(
+        sid, "p", "p-subtask-0", "worker-3", attempt=2, lease_deadline=99.5
+    )
+
+    resumed = JobStore(journal_dir=jd)
+    spec = resumed.get_job(sid, "p")["subtasks"]["p-subtask-0"]["spec"]
+    assert spec["placed_worker"] == "worker-3"
+    assert spec["placed_attempt"] == 2
+    assert spec["lease_deadline"] == 99.5
+    # the sibling was never placed: no stamps
+    other = resumed.get_job(sid, "p")["subtasks"]["p-subtask-1"]["spec"]
+    assert "placed_worker" not in other
+    assert resumed.replay_ops["place"] == 1
+    assert resumed.replay_ops["create_job"] == 1
+
+
+def test_unfinished_counts_for_admission():
+    store = JobStore()
+    sid_a = store.create_session()
+    sid_b = store.create_session()
+    store.create_job(sid_a, "a1", {}, [{"subtask_id": f"a1-s{i}"} for i in range(4)])
+    store.create_job(sid_b, "b1", {}, [{"subtask_id": "b1-s0"}])
+    store.update_subtask(sid_a, "a1", "a1-s0", "completed", {"mean_cv_score": 1.0})
+    counts = store.unfinished_counts()
+    assert counts["jobs"] == 2
+    assert counts["per_session"] == {sid_a: 1, sid_b: 1}
+    assert counts["pending_subtasks"] == 4  # 3 left on a1 + 1 on b1
+    store.finalize_job(sid_b, "b1", {"results": [], "best_result": None})
+    counts = store.unfinished_counts()
+    assert counts["jobs"] == 1
+    assert counts["per_session"] == {sid_a: 1}
+
+
 def test_coordinator_resumes_inflight_job():
     """A coordinator killed mid-job must complete the job after restart with
     NO client resubmission: journal replay restores state, resume_inflight

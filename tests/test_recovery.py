@@ -1,0 +1,554 @@
+"""Coordinator crash recovery + overload survival (docs/ROBUSTNESS.md
+"Coordinator recovery", "Admission control & overload survival"):
+
+- liveness/readiness split and the recovery report
+- admission control: per-session/global caps and the queue-depth
+  watermark reject with 429 + Retry-After; recovery rejects with 503;
+  admitted jobs still complete
+- graceful degradation: speculative launches and prewarm hints shed
+  first in the soft-overload band
+- cluster-mode journal recovery: placed in-flight subtasks resume under
+  a fresh attempt id, duplicate results dedup at ingest
+- reconnecting edges: the worker agent re-registers after a coordinator
+  restart and flushes its buffered results; the client retries through
+  429/503 (honoring Retry-After) and resumes a dropped SSE stream
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from cs230_distributed_machine_learning_tpu.client.manager import MLTaskManager
+from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+LOGREG_JOB = {
+    "dataset_id": "iris",
+    "model_details": {
+        "model_type": "LogisticRegression",
+        "search_type": None,
+        "base_estimator_params": {"max_iter": 300},
+    },
+    "train_params": {},
+}
+
+
+def _counter(name, **labels) -> float:
+    c = REGISTRY.get(name)
+    return c.value(**labels) if c is not None else 0.0
+
+
+def _serve(coord, port=0):
+    """Real-socket server for reconnect tests; returns (server, port)."""
+    from werkzeug.serving import make_server
+
+    server = make_server("127.0.0.1", port, create_app(coord), threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_port
+
+
+# ---------------- liveness / readiness ----------------
+
+
+def test_livez_readyz_split_and_healthz_ready():
+    from werkzeug.test import Client
+
+    coord = Coordinator()
+    client = Client(create_app(coord))
+    assert client.get("/livez").status_code == 200
+    ready = client.get("/readyz")
+    assert ready.status_code == 200
+    assert ready.get_json()["status"] == "ready"
+
+    coord.ready = False  # what a recovering coordinator reports
+    assert client.get("/livez").status_code == 200  # alive regardless
+    ready = client.get("/readyz")
+    assert ready.status_code == 503
+    assert "Retry-After" in ready.headers
+    assert ready.get_json()["status"] == "recovering"
+    hz = client.get("/healthz").get_json()
+    assert hz["ready"] is False
+    assert hz["status"] == "degraded"
+
+
+def test_recovery_report_surfaces_replayed_ops():
+    """A journaled coordinator restart exposes the recovery breakdown on
+    /readyz and /healthz, and sets the recovery gauge."""
+    from werkzeug.test import Client
+
+    coord = Coordinator(journal=True)
+    m = MLTaskManager(coordinator=coord)
+    from sklearn.linear_model import LogisticRegression
+
+    m.train(LogisticRegression(max_iter=300), "iris", show_progress=False)
+
+    coord2 = Coordinator(journal=True)  # same storage root -> replays
+    assert coord2.ready
+    assert coord2.recovery["replayed_ops"]["create_job"] >= 1
+    assert coord2.recovery["replayed_ops"]["finalize_job"] >= 1
+    assert coord2.recovery["recovery_seconds"] >= 0.0
+    assert coord2.recovery["jobs_resumed"] == 0  # the job had finalized
+    client = Client(create_app(coord2))
+    assert client.get("/readyz").get_json()["recovery"]["replayed_ops"]
+    g = REGISTRY.get("tpuml_coordinator_recovery_seconds")
+    assert g is not None and g.value() >= 0.0
+
+
+# ---------------- admission control ----------------
+
+
+def _fake_unfinished_job(store, sid, jid, n_subtasks=1):
+    store.create_job(
+        sid, jid, {}, [{"subtask_id": f"{jid}-s{i}"} for i in range(n_subtasks)]
+    )
+
+
+def test_admission_session_cap_rejects_then_admits():
+    """Submits beyond the per-session in-flight cap get 429 + Retry-After;
+    once load drains the same submit is admitted and completes."""
+    from werkzeug.test import Client
+
+    cfg = get_config()
+    cfg.service.max_inflight_jobs_per_session = 1
+    coord = Coordinator()
+    client = Client(create_app(coord))
+    sid = client.post("/create_session").get_json()["session_id"]
+    _fake_unfinished_job(coord.store, sid, "occupant")
+
+    before = _counter("tpuml_jobs_rejected_total", reason="session_inflight")
+    resp = client.post(f"/train/{sid}", json=LOGREG_JOB)
+    assert resp.status_code == 429
+    assert float(resp.headers["Retry-After"]) > 0
+    body = resp.get_json()
+    assert body["status"] == "rejected"
+    assert body["reason"] == "session_inflight"
+    assert (
+        _counter("tpuml_jobs_rejected_total", reason="session_inflight")
+        == before + 1
+    )
+
+    # another session is NOT blocked by this session's load
+    sid_b = client.post("/create_session").get_json()["session_id"]
+    assert coord.admission_check(sid_b) is None
+
+    # drain, then the admitted job runs to completion
+    coord.store.finalize_job(sid, "occupant", {"results": [], "best_result": None})
+    resp = client.post(f"/train/{sid}", json=LOGREG_JOB)
+    assert resp.status_code == 200
+    jid = resp.get_json()["job_id"]
+    assert coord.store.wait_job(sid, jid, timeout=120)
+    status = client.get(f"/check_status/{sid}/{jid}").get_json()
+    assert status["job_status"] == "completed"
+
+
+def test_admission_queue_watermark_and_global_cap():
+    from werkzeug.test import Client
+
+    cfg = get_config()
+    cfg.service.admission_queue_watermark = 5
+    coord = Coordinator()
+    client = Client(create_app(coord))
+    sid = client.post("/create_session").get_json()["session_id"]
+    _fake_unfinished_job(coord.store, sid, "deep", n_subtasks=5)
+    resp = client.post(f"/train/{sid}", json=LOGREG_JOB)
+    assert resp.status_code == 429
+    assert resp.get_json()["reason"] == "queue_depth"
+
+    cfg.service.admission_queue_watermark = 50000
+    cfg.service.max_inflight_jobs = 1
+    resp = client.post(f"/train/{sid}", json=LOGREG_JOB)
+    assert resp.status_code == 429
+    assert resp.get_json()["reason"] == "global_inflight"
+
+
+def test_recovering_coordinator_answers_503():
+    from werkzeug.test import Client
+
+    coord = Coordinator()
+    client = Client(create_app(coord))
+    sid = client.post("/create_session").get_json()["session_id"]
+    coord.ready = False
+    resp = client.post(f"/train/{sid}", json=LOGREG_JOB)
+    assert resp.status_code == 503
+    assert "Retry-After" in resp.headers
+    assert resp.get_json()["reason"] == "recovering"
+
+
+def test_soft_overload_sheds_speculation_and_prewarm(monkeypatch):
+    """Above shed_fraction of a cap the OPTIONAL work goes first:
+    _speculate launches nothing and prewarm hints are withheld — while
+    admission still admits (shed band < reject band)."""
+    from cs230_distributed_machine_learning_tpu.runtime.scheduler import (
+        PlacementEngine,
+    )
+
+    monkeypatch.setenv("CS230_PREWARM", "1")
+    cfg = get_config()
+    cfg.service.max_inflight_jobs = 10
+    cfg.service.shed_fraction = 0.5
+    coord = Coordinator()
+    sid = coord.create_session()
+    for i in range(5):  # 5 >= 0.5 * 10 -> shedding, but < 10 -> admitted
+        _fake_unfinished_job(coord.store, sid, f"j{i}")
+    assert coord.overload_shedding() is True
+    assert coord.admission_check(sid) is None
+
+    before = _counter("tpuml_overload_shed_total", kind="prewarm")
+    assert coord.prewarm_hints() == []
+    assert _counter("tpuml_overload_shed_total", kind="prewarm") == before + 1
+
+    engine = PlacementEngine()
+    engine.shed_check = coord.overload_shedding
+    before = _counter("tpuml_overload_shed_total", kind="speculative")
+    assert engine._speculate() == []
+    assert (
+        _counter("tpuml_overload_shed_total", kind="speculative") == before + 1
+    )
+
+
+def test_submit_train_duplicate_job_id_deduped():
+    """A resubmit of a client-minted job_id returns the original
+    acceptance instead of double-expanding — what makes client submit
+    retries and SSE resumes idempotent."""
+    coord = Coordinator()
+    sid = coord.create_session()
+    payload = {**LOGREG_JOB, "job_id": "fixed-job"}
+    first = coord.submit_train(sid, payload)
+    second = coord.submit_train(sid, payload)
+    assert second["duplicate"] is True
+    assert second["job_id"] == first["job_id"]
+    assert second["total_subtasks"] == first["total_subtasks"]
+    assert coord.store.wait_job(sid, "fixed-job", timeout=120)
+    assert len(coord.store.jobs_overview()) == 1
+
+
+# ---------------- cluster-mode journal recovery ----------------
+
+
+def test_cluster_restart_resumes_placed_subtasks_with_fresh_attempt():
+    """The post-crash boot: a journal holding one completed and two
+    PLACED-but-unreported subtasks resumes on a fresh cluster — the job
+    completes, the placed subtasks run under a bumped attempt id (zombie
+    reports from the dead coordinator's era are stale by construction),
+    and a late duplicate result is dropped without double-counting."""
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    jd = get_config().storage.journal_dir
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    model_details = {
+        "model_type": "LogisticRegression",
+        "search_type": "GridSearchCV",
+        "base_estimator_params": {"max_iter": 300},
+        "param_grid": {"C": [0.1, 1.0, 10.0]},
+    }
+    subtasks = create_subtasks("jobc", sid, "iris", model_details, {"cv": 3})
+    store.create_job(sid, "jobc", {"dataset_id": "iris"}, subtasks)
+    done_stid = subtasks[0]["subtask_id"]
+    store.update_subtask(
+        sid, "jobc", done_stid, "completed",
+        {"subtask_id": done_stid, "status": "completed",
+         "mean_cv_score": 0.91, "accuracy": 0.9, "attempt": 0},
+    )
+    # the other two were PLACED when the coordinator died
+    for st in subtasks[1:]:
+        store.record_placement(
+            sid, "jobc", st["subtask_id"], "worker-dead", attempt=0,
+            lease_deadline=time.time() + 60,
+        )
+    del store
+
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster, journal=True)
+        assert coord.ready
+        assert coord.recovery["jobs_resumed"] == 1
+        assert coord.recovery["subtasks_requeued"] == 2
+        assert coord.store.wait_job(sid, "jobc", timeout=300)
+        status = coord.check_status(sid, "jobc")
+        assert status["job_status"] == "completed"
+        results = status["job_result"]["results"]
+        assert len(results) == 3
+        assert len({r["subtask_id"] for r in results}) == 3
+        # the resumed copies ran under a bumped attempt (recovery stamp)
+        job = coord.store.get_job(sid, "jobc")
+        for st in subtasks[1:]:
+            spec = job["subtasks"][st["subtask_id"]]["spec"]
+            assert spec["attempt"] >= 1, "placed subtask resumed on attempt 0"
+        assert job["subtasks"][done_stid]["result"]["mean_cv_score"] == 0.91
+
+        # a zombie duplicate arriving after completion must not double
+        # count (at-least-once re-ingest: dropped, store unchanged)
+        cluster.bus.publish(
+            "result",
+            {"subtask_id": done_stid, "job_id": "jobc",
+             "status": "completed", "mean_cv_score": 0.5, "attempt": 0},
+            key=done_stid,
+        )
+        time.sleep(0.3)
+        progress = coord.store.job_progress(sid, "jobc")
+        assert progress["tasks_completed"] == 3
+        assert (
+            coord.store.get_job(sid, "jobc")["subtasks"][done_stid]["result"][
+                "mean_cv_score"
+            ]
+            == 0.91
+        )
+    finally:
+        cluster.shutdown()
+
+
+# ---------------- reconnecting edges: worker agent ----------------
+
+
+def test_agent_reregisters_and_flushes_buffer_across_restart():
+    """Kill the coordinator under a live agent: results posted during the
+    outage park in the agent's bounded buffer; when a NEW coordinator
+    (same port, fresh registry) comes up, the agent's next poll sees 404,
+    re-registers under a fresh worker id, and the buffer flushes into the
+    new coordinator's result bus."""
+    from cs230_distributed_machine_learning_tpu.runtime.agent import WorkerAgent
+
+    cluster1 = ClusterRuntime()
+    coord1 = Coordinator(cluster=cluster1)
+    server1, port = _serve(coord1)
+    url = f"http://127.0.0.1:{port}"
+    agent = None
+    cluster2 = None
+    server2 = None
+    try:
+        agent = WorkerAgent(
+            url, poll_timeout_s=0.2, register_retries=40,
+            register_backoff_s=0.1,
+        )
+        old_wid = agent.worker_id
+        assert old_wid in cluster1.engine.workers
+
+        # coordinator dies
+        server1.shutdown()
+        cluster1.shutdown()
+        server1 = None
+
+        # a result finished during the outage: parked, not lost
+        agent._post_result(
+            "st-buffered", "completed",
+            {"subtask_id": "st-buffered", "status": "completed",
+             "mean_cv_score": 0.7},
+        )
+        assert len(agent._result_buffer) == 1
+        assert _counter("tpuml_agent_results_buffered_total") >= 1
+
+        # a fresh coordinator on the SAME port (restart) with empty books
+        cluster2 = ClusterRuntime()
+        coord2 = Coordinator(cluster=cluster2)
+        server2, _ = _serve(coord2, port=port)
+        sub = cluster2.bus.subscribe("result")
+
+        assert agent._poll_tasks() == []  # 404 -> re-register + flush
+        # a FRESH registration with the new coordinator (ids are per-
+        # coordinator monotonic, so the string may coincide with the old)
+        assert agent.worker_id in cluster2.engine.workers
+        key, result = sub.get(timeout=10)
+        assert key == "st-buffered"
+        assert result["mean_cv_score"] == 0.7
+        assert len(agent._result_buffer) == 0
+        assert _counter("tpuml_agent_reconnects_total") >= 1
+    finally:
+        if agent is not None:
+            agent.stop(unsubscribe=False)
+        if server1 is not None:
+            server1.shutdown()
+        if server2 is not None:
+            server2.shutdown()
+        if cluster2 is not None:
+            cluster2.shutdown()
+
+
+def test_agent_result_buffer_is_bounded():
+    from cs230_distributed_machine_learning_tpu.runtime.agent import WorkerAgent
+
+    cluster = ClusterRuntime()
+    coord = Coordinator(cluster=cluster)
+    server, port = _serve(coord)
+    try:
+        agent = WorkerAgent(
+            f"http://127.0.0.1:{port}", poll_timeout_s=0.2,
+            result_buffer=3,
+        )
+        server.shutdown()
+        server = None
+        for i in range(5):
+            agent._post_result(
+                f"st-{i}", "completed",
+                {"subtask_id": f"st-{i}", "status": "completed"},
+            )
+        assert len(agent._result_buffer) == 3
+        kept = [stid for stid, _ in agent._result_buffer]
+        assert kept == ["st-2", "st-3", "st-4"]  # oldest dropped first
+    finally:
+        if server is not None:
+            server.shutdown()
+        cluster.shutdown()
+
+
+# ---------------- reconnecting edges: client ----------------
+
+
+class _FakeResp:
+    def __init__(self, status, body=None, headers=None):
+        self.status_code = status
+        self._body = body or {}
+        self.headers = headers or {}
+
+    def raise_for_status(self):
+        import requests
+
+        if self.status_code >= 400:
+            raise requests.HTTPError(f"{self.status_code}", response=self)
+
+    def json(self):
+        return self._body
+
+
+def test_client_request_honors_retry_after_on_429(monkeypatch):
+    import requests
+
+    calls = []
+
+    def fake_request(method, url, **kw):
+        calls.append(time.time())
+        if len(calls) == 1:
+            return _FakeResp(429, headers={"Retry-After": "0.05"})
+        return _FakeResp(200, {"ok": True})
+
+    monkeypatch.setattr(requests, "request", fake_request)
+    m = MLTaskManager.__new__(MLTaskManager)
+    m.api_url = "http://coordinator.invalid"
+    out = m._request("get", "check_status/s/j")
+    assert out == {"ok": True}
+    assert len(calls) == 2
+    assert calls[1] - calls[0] >= 0.05  # waited at least Retry-After
+
+
+def test_client_get_retries_connection_error_post_raises(monkeypatch):
+    import requests
+
+    calls = {"n": 0}
+
+    def fake_request(method, url, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise requests.ConnectionError("coordinator down")
+        return _FakeResp(200, {"ok": True})
+
+    monkeypatch.setattr(requests, "request", fake_request)
+    m = MLTaskManager.__new__(MLTaskManager)
+    m.api_url = "http://coordinator.invalid"
+    assert m._request("get", "jobs") == {"ok": True}  # GET: retried
+    assert calls["n"] == 2
+
+    calls["n"] = 0
+    with pytest.raises(requests.ConnectionError):
+        # non-idempotent POST: raises immediately, no blind replay
+        m._request("post", "create_session")
+    assert calls["n"] == 1
+
+
+def test_client_retry_window_zero_restores_legacy_raise(monkeypatch):
+    import requests
+
+    get_config().service.request_retry_s = 0.0
+
+    def fake_request(method, url, **kw):
+        return _FakeResp(429, headers={"Retry-After": "0.01"})
+
+    monkeypatch.setattr(requests, "request", fake_request)
+    m = MLTaskManager.__new__(MLTaskManager)
+    m.api_url = "http://coordinator.invalid"
+    with pytest.raises(requests.HTTPError):
+        m._request("get", "jobs")
+
+
+def test_sse_stream_resumes_after_drop():
+    """A /train_status stream that dies without a terminal event is
+    resumed by re-POSTing the (job_id-deduped) submit; the client returns
+    the terminal event from the SECOND stream instead of raising."""
+    from werkzeug.serving import make_server
+
+    posts = []
+
+    def app(environ, start_response):
+        posts.append(environ["PATH_INFO"])
+        start_response("200 OK", [("Content-Type", "text/event-stream")])
+        if len(posts) == 1:
+            # one progress snapshot, then the connection drops mid-job
+            return [b'data: {"job_status": "33.3%", "tasks_completed": 1}\n\n']
+        return [
+            b'data: {"job_status": "completed", '
+            b'"job_result": {"best_result": null}}\n\n'
+        ]
+
+    server = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        m = MLTaskManager.__new__(MLTaskManager)
+        m.api_url = f"http://127.0.0.1:{server.server_port}"
+        m.session_id = "s"
+        m.job_id = "j"
+        m.trace_id = None
+        out = m._train_stream(
+            {"job_id": "j", **LOGREG_JOB}, timeout=30, show_progress=False
+        )
+        assert out["job_status"] == "completed"
+        assert m.result == {"best_result": None}
+        assert len(posts) == 2  # the resume re-POST happened
+    finally:
+        server.shutdown()
+
+
+def test_sse_resume_bypasses_admission():
+    """An SSE resume (known job_id) must never be 429'd — the
+    reconnecting client is following load the coordinator ALREADY
+    accepted."""
+    from werkzeug.test import Client
+
+    cfg = get_config()
+    coord = Coordinator()
+    client = Client(create_app(coord))
+    sid = client.post("/create_session").get_json()["session_id"]
+    payload = {**LOGREG_JOB, "job_id": "sse-job"}
+    resp = client.post(f"/train_status/{sid}", json=payload)
+    assert resp.status_code == 200
+    # drain the first stream to completion so the job exists + finishes
+    events = [
+        json.loads(line[len("data: "):])
+        for line in resp.get_data(as_text=True).splitlines()
+        if line.startswith("data: ")
+    ]
+    assert events[-1]["job_status"] == "completed"
+
+    # now the coordinator is saturated: NEW submits are rejected...
+    cfg.service.max_inflight_jobs = 1
+    _fake_unfinished_job(coord.store, sid, "occupant")
+    reject = client.post(
+        f"/train_status/{sid}", json={**LOGREG_JOB, "job_id": "brand-new"}
+    )
+    assert reject.status_code == 429
+    # ...but the resume of the KNOWN job streams fine
+    resume = client.post(f"/train_status/{sid}", json=payload)
+    assert resume.status_code == 200
+    final = [
+        json.loads(line[len("data: "):])
+        for line in resume.get_data(as_text=True).splitlines()
+        if line.startswith("data: ")
+    ][-1]
+    assert final["job_status"] == "completed"
