@@ -107,6 +107,7 @@ class MLTaskManager:
         *,
         dataset_name: Optional[str] = None,
         stream: bool = False,
+        search_params: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Submit a training / hyperparameter-search job.
 
@@ -114,6 +115,16 @@ class MLTaskManager:
         estimator default test_size matches the reference (core.py:160-163).
         ``dataset_name=`` is accepted as an alias for ``dataset_id`` — the
         reference README's examples use that keyword (README.md:70-76).
+
+        ``search_params=`` opts the job into adaptive search
+        (docs/SEARCH.md): ``{"type": "asha" | "hyperband", "eta": 3,
+        "min_resource": r, "max_resource": R, "n_iter": n,
+        "stop_score": s, "max_brackets": b}``. The estimator's
+        param grid/distributions supply the trial configurations (a
+        RandomizedSearchCV wrapper works as-is); the rung controller owns
+        the resource knob (max_iter / n_estimators) and stops doomed
+        trials early with the ``pruned`` terminal status. Progress events
+        then carry ``tasks_pruned`` and a per-rung ``search`` summary.
 
         ``stream=True`` (with ``wait_for_completion``) follows the job by
         CONSUMING the server-sent-event stream instead of polling: remote
@@ -133,6 +144,19 @@ class MLTaskManager:
         if dataset_id is None:
             raise TypeError("train() requires a dataset id (dataset_id= or dataset_name=)")
         model_details = extract_model_details(estimator)
+        if search_params:
+            sp = dict(search_params)
+            stype = sp.pop("type", "asha")
+            if stype not in ("asha", "hyperband"):
+                raise ValueError(
+                    f"search_params['type'] must be 'asha' or 'hyperband', "
+                    f"got {stype!r}"
+                )
+            model_details["search_type"] = stype
+            for key in ("n_iter", "random_state"):
+                if key in sp:
+                    model_details[key] = sp.pop(key)
+            model_details["asha"] = sp
         train_params = dict(train_params or {})
         train_params.setdefault("test_size", get_config().execution.default_test_size)
         self.job_id = str(uuid.uuid4())
@@ -209,6 +233,7 @@ class MLTaskManager:
                 job_status = status.get("job_status")
                 if bar is not None:
                     bar.n = int(_pct(job_status))
+                    _bar_postfix(bar, status)
                     bar.refresh()
                 if job_status in TERMINAL_STATUSES:
                     self.result = status.get("job_result")
@@ -260,6 +285,7 @@ class MLTaskManager:
                 last = progress
                 if bar is not None:
                     bar.n = int(_pct(progress.get("job_status")))
+                    _bar_postfix(bar, progress)
                     bar.refresh()
                 if progress.get("job_status") in TERMINAL_STATUSES:
                     break
@@ -354,6 +380,7 @@ class MLTaskManager:
                         attempt = 0  # real progress resets the backoff
                         if bar is not None:
                             bar.n = int(_pct(event.get("job_status")))
+                            _bar_postfix(bar, event)
                             bar.refresh()
                         if event.get("job_status") in TERMINAL_STATUSES:
                             return self._finish_stream(last, timeout)
@@ -530,6 +557,32 @@ def _retry_delay(attempt: int, retry_after=None, cap: float = 30.0) -> float:
         except (TypeError, ValueError):
             pass
     return min(10.0, 0.5 * 2 ** min(attempt - 1, 5)) * (0.5 + random.random())
+
+
+def _bar_postfix(bar, progress: Dict[str, Any]) -> None:
+    """Adaptive-search progress decoration (docs/SEARCH.md): pruned count
+    and the highest active rung ride the tqdm postfix so a user watching
+    the bar sees the controller working, not just percent-done."""
+    pruned = progress.get("tasks_pruned")
+    search = progress.get("search")
+    if not pruned and not search:
+        return
+    post = {}
+    if pruned:
+        post["pruned"] = pruned
+    if isinstance(search, dict):
+        rungs = [
+            r
+            for b in (search.get("brackets") or [search])
+            for r in (b.get("rungs") or [])
+            if r.get("reported")
+        ]
+        if rungs:
+            post["rung"] = max(r["rung"] for r in rungs)
+    try:
+        bar.set_postfix(post, refresh=False)
+    except Exception:  # noqa: BLE001 — cosmetic only
+        pass
 
 
 def _pct(job_status) -> float:

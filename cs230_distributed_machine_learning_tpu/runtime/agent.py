@@ -105,6 +105,8 @@ class WorkerAgent:
         self._buffer_lock = threading.Lock()
         self._reconnect_lock = threading.Lock()
         self._poll_failures = 0
+        #: cancel list of the most recent successful poll (see _poll_tasks)
+        self._last_cancels: List[Dict[str, Any]] = []
         #: prewarm hints shipped in the /subscribe response (the runtime
         #: predictor's hot families bound to recent job shapes); warmed in
         #: the background by start() so the first placed trial finds a
@@ -228,7 +230,15 @@ class WorkerAgent:
                 self._resubscribe()
                 return []
             resp.raise_for_status()
-            tasks = resp.json().get("tasks", [])
+            body = resp.json()
+            tasks = body.get("tasks", [])
+            # cooperative-cancel list (docs/SEARCH.md): feed the executor
+            # so pruned-mid-flight attempts stop at the next batch
+            # boundary; kept for run_distributed to broadcast so every
+            # SPMD rank filters the same set (lockstep contract)
+            self._last_cancels = body.get("cancel") or []
+            if self._last_cancels:
+                self.executor.cancel(self._last_cancels)
         except Exception:  # noqa: BLE001
             self._poll_failures += 1
             backoff = min(
@@ -638,12 +648,19 @@ def run_distributed(
             if is_primary():
                 stop = agent._stop.is_set()
                 msg = {"tasks": [] if stop else agent._poll_tasks(),
-                       "stop": stop}
+                       "stop": stop,
+                       # cancels broadcast with the tasks: every rank must
+                       # filter the SAME set or the lockstep collectives
+                       # desync (agent._poll_tasks already applied them to
+                       # the primary's shared executor)
+                       "cancel": agent._last_cancels}
             else:
                 msg = None
             msg = broadcast_json(msg)  # lockstep rendezvous, every iteration
             if msg["stop"]:
                 break
+            if msg.get("cancel") and agent is None:
+                executor.cancel(msg["cancel"])
             tasks = msg["tasks"]
             if not tasks:
                 continue

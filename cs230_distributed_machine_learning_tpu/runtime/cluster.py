@@ -31,6 +31,7 @@ from .executor import DeviceLostError, LocalExecutor
 from .faults import AttemptLedger
 from .queue import TopicBus
 from .scheduler import TOPIC_TASKS, TOPIC_TRAIN, PlacementEngine
+from .store import SUBTASK_TERMINAL_STATUSES
 
 logger = get_logger("tpuml.cluster")
 
@@ -101,7 +102,10 @@ class ExecutorWorker:
                 result = {**(result or {}), "worker_id": self.worker_id}
                 failed = status == "failed"
                 self.cluster.engine.record_outcome(self.worker_id, not failed)
-                if failed:
+                if failed or status == "pruned":
+                    # neither emits a timed metrics message: release the
+                    # engine's books here (pruned = cooperative cancel,
+                    # docs/SEARCH.md — a non-failure terminal)
                     self.cluster.engine.release_task(self.worker_id, stid)
                 self.cluster.bus.publish(TOPIC_RESULT, result, key=stid)
 
@@ -148,6 +152,13 @@ class ClusterRuntime:
         self.cache = cache
         self.workers: Dict[str, ExecutorWorker] = {}
         self._remote_subs: Dict[str, Any] = {}
+        #: cooperative-cancel registry (docs/SEARCH.md): subtask_id ->
+        #: {subtask_id, attempt, job_id}. Served on every /next_tasks
+        #: long-poll (the agents' cancel list) and pushed straight into
+        #: in-process workers' executors; entries clear when the
+        #: subtask's terminal result lands or its job's loop ends.
+        self._cancel_lock = threading.Lock()
+        self._cancels: Dict[str, Dict[str, Any]] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         for target in (self._ingress_loop, self._metrics_loop):
@@ -235,6 +246,38 @@ class ClusterRuntime:
                 key=stid,
             )
 
+    # ---------------- cooperative cancel (docs/SEARCH.md) ----------------
+
+    def cancel_subtask(
+        self, subtask_id: str, attempt: int = 0,
+        job_id: Optional[str] = None,
+    ) -> None:
+        """Mark a subtask's current attempt cancelled. Remote agents pick
+        it up from their next poll's ``cancel`` list; in-process workers'
+        executors are updated immediately. The executor stops the trial at
+        the next batch boundary and posts a terminal ``pruned`` result; a
+        dead/ignoring worker is covered by the lease reclaim + the
+        ledger's ``is_done`` requeue drop."""
+        entry = {
+            "subtask_id": subtask_id,
+            "attempt": int(attempt or 0),
+            "job_id": job_id,
+        }
+        with self._cancel_lock:
+            self._cancels[subtask_id] = entry
+        counter_inc("tpuml_cancels_issued_total")
+        for worker in list(self.workers.values()):
+            worker.executor.cancel([entry])
+
+    def cancel_list(self) -> List[Dict[str, Any]]:
+        with self._cancel_lock:
+            return list(self._cancels.values())
+
+    def clear_cancels(self, subtask_ids) -> None:
+        with self._cancel_lock:
+            for stid in subtask_ids:
+                self._cancels.pop(stid, None)
+
     # ---------------- remote agents (DCN control plane) ----------------
     # A remote WorkerAgent (runtime/agent.py) on another host registers here
     # over REST and long-polls its keyed train queue — the HTTP analog of the
@@ -297,10 +340,14 @@ class ClusterRuntime:
                 attempt=int(result.get("attempt") or 0),
             )
         self.engine.record_outcome(worker_id, ok)
-        if not ok:
-            # failed attempts emit no metrics message: release the engine's
-            # books (queue entry, load, lease) for the reporting worker
+        if result.get("status") in ("failed", "pruned"):
+            # failed attempts emit no metrics message, and a pruned
+            # attempt's release message may race the result: release the
+            # engine's books (queue entry, load, lease) here (idempotent —
+            # release_task no-ops once the books are clear)
             self.engine.release_task(worker_id, result.get("subtask_id"))
+        if result.get("status") in SUBTASK_TERMINAL_STATUSES:
+            self.clear_cancels([result.get("subtask_id")])
         # count the outcome coordinator-side so /metrics/prom sees subtasks
         # executed in other processes — but not twice for an agent sharing
         # THIS process (its executor already counted into the shared

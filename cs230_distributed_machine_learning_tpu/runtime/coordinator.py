@@ -37,7 +37,8 @@ from ..utils.serialization import json_safe
 from .artifacts import save_artifact
 from .executor import LocalExecutor
 from .queue import TopicBus
-from .store import TERMINAL_STATUSES, JobStore
+from .search import SearchJobDriver, Step
+from .store import SUBTASK_TERMINAL_STATUSES, TERMINAL_STATUSES, JobStore
 from .subtasks import create_subtasks
 
 logger = get_logger("tpuml.coordinator")
@@ -194,7 +195,8 @@ class Coordinator:
             existing = {
                 stid: sub["result"]
                 for stid, sub in job["subtasks"].items()
-                if sub["status"] in ("completed", "failed") and sub["result"]
+                if sub["status"] in SUBTASK_TERMINAL_STATUSES
+                and sub["result"]
             }
             remaining = [
                 st for st in specs if st["subtask_id"] not in existing
@@ -478,8 +480,38 @@ class Coordinator:
         def on_metrics(msg: Dict[str, Any]):
             self.bus.publish(TOPIC_METRICS, msg, key=msg.get("subtask_id"))
 
+        def on_intermediate(subtask_id: str, result: Optional[Dict[str, Any]]):
+            # non-terminal rung boundary (promoted/paused): journal the
+            # report + record the event, but do NOT publish to the result
+            # topic — in cluster mode that topic is this coordinator's own
+            # ingest channel, and republishing would echo the report back
+            # into the rung loop forever
+            self.store.update_subtask(
+                sid, job_id, subtask_id, "promoted", result
+            )
+            r = result or {}
+            record_event(
+                "result", job_id=job_id, subtask_id=subtask_id,
+                worker_id=r.get("worker_id"),
+                attempt=int(r.get("attempt") or 0), status="promoted",
+                mean_cv_score=r.get("mean_cv_score"),
+                rung=(r.get("asha") or {}).get("rung"),
+            )
+
         existing = existing or {}
         remaining = [st for st in subtasks if st["subtask_id"] not in existing]
+        # adaptive-search job (docs/SEARCH.md): specs carry an ``asha``
+        # rung block — route through the rung controller instead of the
+        # run-everything-to-completion paths below
+        driver: Optional[SearchJobDriver] = None
+        if any(st.get("asha") for st in subtasks):
+            driver = SearchJobDriver(subtasks)
+            # rebuild rung state from the journaled rung history — always,
+            # not just when a terminal result exists: a coordinator killed
+            # after rung-0 reports but before the first prune/complete has
+            # promotions to re-derive too (a fresh job's empty history is
+            # a no-op). Determinism means nothing is promoted twice.
+            driver.resume(self.store.get_job(sid, job_id))
         # job threads start with an empty contextvar context: re-activate the
         # trace the subtask specs carry (journaled specs keep it across a
         # coordinator restart, so resumed jobs stitch into the same trace)
@@ -492,10 +524,24 @@ class Coordinator:
                 with span("job.execute", trace_id=trace_id, job_id=job_id,
                           n_subtasks=len(remaining),
                           n_resumed=len(existing),
+                          search="asha" if driver is not None else None,
                           mode="scheduled" if self.cluster is not None
                           else "direct"):
-                    if not remaining:
-                        new_results: List[Dict[str, Any]] = []
+                    if driver is not None:
+                        by_id = dict(existing)
+                        if self.cluster is not None:
+                            by_id.update(self._run_job_search_scheduled(
+                                sid, job_id, driver, on_result,
+                                on_intermediate,
+                            ))
+                        else:
+                            by_id.update(self._run_job_search_direct(
+                                sid, job_id, driver, on_result,
+                                on_intermediate, on_metrics,
+                            ))
+                        new_results = []
+                    elif not remaining:
+                        new_results = []
                     elif self.cluster is not None:
                         new_results = self._run_job_scheduled(
                             sid, job_id, remaining, on_result
@@ -504,12 +550,18 @@ class Coordinator:
                         new_results = self.executor.run_subtasks(
                             remaining, on_result=on_result, on_metrics=on_metrics
                         )
-                by_id = dict(existing)
-                for st, r in zip(remaining, new_results):
-                    by_id[st["subtask_id"]] = r
+                if driver is None:
+                    by_id = dict(existing)
+                    for st, r in zip(remaining, new_results):
+                        by_id[st["subtask_id"]] = r
                 results = [by_id.get(st["subtask_id"]) for st in subtasks]
                 with span("job.aggregate", trace_id=trace_id, job_id=job_id):
-                    self._aggregate(sid, job_id, subtasks, results)
+                    self._aggregate(
+                        sid, job_id, subtasks, results,
+                        search_summary=(
+                            driver.summary() if driver is not None else None
+                        ),
+                    )
             counter_inc("tpuml_jobs_completed_total")
         except Exception as e:  # noqa: BLE001
             logger.exception("Job %s failed", job_id)
@@ -718,12 +770,316 @@ class Coordinator:
             sub.close()
             self.cluster.ledger.forget(wanted)
 
-    def _aggregate(self, sid, job_id, subtasks, results) -> None:
+    # ------------- adaptive search (docs/SEARCH.md) -------------
+
+    def _apply_search_step(
+        self, step: Step, sid, job_id, pending, results_by_id, on_result,
+        on_intermediate, metadata,
+    ) -> None:
+        """Apply one rung-controller step to the scheduled job loop:
+        journal intermediate (promoted) results FIRST, then issue cancels,
+        finalize terminals, and submit the fresh rung dispatches LAST — so
+        a crash between any two phases replays into a state the resume
+        path handles (an unjournaled dispatch is re-issued; a journaled
+        report re-derives its promotion)."""
+        ledger = self.cluster.ledger
+        for tid, res in step.promoted:
+            if res is not None:
+                on_intermediate(tid, res)
+        new_tasks = []
+        for task in step.new_tasks:
+            task.pop("speculative", None)
+            ledger.next_attempt(task, reason="promotion")
+            new_tasks.append(task)
+        for c in step.cancels:
+            self.cluster.cancel_subtask(
+                c["subtask_id"], c.get("attempt", 0), job_id=job_id
+            )
+        for tid, status, res in step.finished:
+            pending.discard(tid)
+            ledger.mark_done(tid)
+            results_by_id[tid] = res
+            on_result(tid, status, res)
+            # deliberately NOT clearing the cancel registry here: a prune's
+            # synthesized terminal lands in the SAME step as its cancel, and
+            # clearing now would empty the registry before any remote
+            # agent's next poll ever saw the entry. The registry clears when
+            # the WORKER's own terminal result arrives (push_result) or at
+            # job end (the loop's finally).
+        if new_tasks:
+            self.cluster.submit(new_tasks, metadata=metadata)
+
+    def _run_job_search_scheduled(
+        self, sid, job_id, driver: SearchJobDriver, on_result,
+        on_intermediate,
+    ) -> Dict[str, Dict[str, Any]]:
+        """Scheduled-mode rung loop: like ``_run_job_scheduled`` (same
+        at-least-once ingest, attempt dedup, bounded retries, poison
+        quarantine) but result ingest feeds the rung controller — a
+        completed rung dispatch may promote its trial (fresh attempt at
+        the eta-times budget), pause it, or prune peers; quarantined
+        trials leave the ladder so their rungs close for the survivors."""
+        import queue as _q
+
+        cfg = self.config.scheduler
+        ledger = self.cluster.ledger
+        all_ids = set(driver.specs)
+        results_by_id: Dict[str, Dict[str, Any]] = {}
+        pending = {tid for tid in all_ids if tid not in driver._finalized}
+        retry_due: List[tuple] = []
+        sub = self.bus.subscribe("result", key_filter=lambda k: k in all_ids)
+        try:
+            job = self.store.get_job(sid, job_id)
+            metadata = job.get("metadata") or None
+            # resume: terminal states the replayed controller derived
+            # whose store writes the crash swallowed
+            self._apply_search_step(
+                driver.resume_step(), sid, job_id, pending, results_by_id,
+                on_result, on_intermediate, metadata,
+            )
+            tasks = driver.pending_tasks()
+            for st in tasks:
+                ledger.seed(st)
+            if tasks:
+                self.cluster.submit(tasks, metadata=metadata)
+            self.store.set_search_state(sid, job_id, driver.summary())
+            stall_grace = self.config.service.client_timeout_s
+            hard_deadline = time.time() + 20.0 * stall_grace
+            last_progress = time.time()
+            while pending:
+                now = time.time()
+                if now > hard_deadline:
+                    raise TimeoutError(
+                        f"{len(pending)} trials unfinished at the hard "
+                        f"deadline ({20.0 * stall_grace:.0f}s)"
+                    )
+                if retry_due:
+                    due = [t for ts, t in retry_due if ts <= now]
+                    if due:
+                        retry_due = [
+                            (ts, t) for ts, t in retry_due if ts > now
+                        ]
+                        self.cluster.submit(due, metadata=metadata)
+                try:
+                    stid, result = sub.get(timeout=0.5)
+                except _q.Empty:
+                    if time.time() - last_progress > stall_grace:
+                        owned: set = {
+                            t["subtask_id"] for _, t in retry_due
+                        }
+                        for q in self.cluster.engine.queue_snapshot().values():
+                            owned.update(q)
+                        if not (pending & owned):
+                            raise TimeoutError(
+                                f"{len(pending)} trials stalled with no "
+                                f"live owner for {stall_grace:.0f}s "
+                                f"(e.g. {sorted(pending)[:3]})"
+                            )
+                        last_progress = time.time()
+                    continue
+                result = result or {}
+                if stid not in pending:
+                    counter_inc("tpuml_results_duplicate_dropped_total")
+                    record_event(
+                        "result.duplicate", job_id=job_id, subtask_id=stid,
+                        worker_id=result.get("worker_id"),
+                        attempt=int(result.get("attempt") or 0),
+                    )
+                    continue
+                status = result.get("status", "completed")
+                if status != "failed":
+                    # a rung report (completed) or a cooperative-cancel
+                    # terminal (pruned) — both feed the controller; the
+                    # driver dedups duplicate/stale deliveries itself
+                    if status == "pruned":
+                        step = driver.handle_pruned_result(stid, result)
+                    else:
+                        step = driver.handle_result(stid, result)
+                    self._apply_search_step(
+                        step, sid, job_id, pending, results_by_id,
+                        on_result, on_intermediate, metadata,
+                    )
+                    self.store.set_search_state(
+                        sid, job_id, driver.summary()
+                    )
+                    last_progress = time.time()
+                    continue
+                # ---- failed rung execution: retry budget / quarantine ----
+                attempt = int(result.get("attempt") or 0)
+                if ledger.is_stale(stid, attempt):
+                    record_event(
+                        "result.stale", job_id=job_id, subtask_id=stid,
+                        worker_id=result.get("worker_id"), attempt=attempt,
+                        error=result.get("error"),
+                    )
+                    continue
+                wid = result.get("worker_id")
+                entry = ledger.record_failure(stid, wid)
+                poisoned = entry.device_losses >= cfg.poison_kill_threshold
+                if poisoned or entry.failures >= cfg.retry_max_attempts:
+                    quarantined = {
+                        **result,
+                        "quarantined": True,
+                        "attempts": entry.failures,
+                        "quarantine_reason": (
+                            "poisoned" if poisoned else "retries_exhausted"
+                        ),
+                    }
+                    counter_inc("tpuml_subtasks_quarantined_total")
+                    logger.error(
+                        "Quarantining trial %s after %d failed attempts "
+                        "(%s): %s", stid, entry.failures,
+                        quarantined["quarantine_reason"],
+                        result.get("error"),
+                    )
+                    record_event(
+                        "quarantine", job_id=job_id, subtask_id=stid,
+                        worker_id=wid, attempt=attempt,
+                        reason=quarantined["quarantine_reason"],
+                        attempts=entry.failures,
+                        device_losses=entry.device_losses,
+                        error=result.get("error"),
+                    )
+                    step = driver.handle_quarantine(stid, quarantined)
+                    self._apply_search_step(
+                        step, sid, job_id, pending, results_by_id,
+                        on_result, on_intermediate, metadata,
+                    )
+                    self.store.set_search_state(
+                        sid, job_id, driver.summary()
+                    )
+                else:
+                    task = dict(driver.specs[stid])
+                    task.pop("speculative", None)
+                    ledger.next_attempt(
+                        task, exclude_worker=wid, reason="failure"
+                    )
+                    # keep the driver's spec in sync with the live attempt
+                    # (the promotion path already does — _stamp stores the
+                    # dict next_attempt mutates): a later prune's
+                    # cooperative cancel must carry THIS attempt, or the
+                    # executor's attempt guard lets the retry run its
+                    # full doomed budget
+                    driver.specs[stid] = task
+                    backoff = min(
+                        cfg.retry_backoff_s * 2 ** max(entry.failures - 1, 0),
+                        cfg.retry_backoff_max_s,
+                    )
+                    counter_inc(
+                        "tpuml_subtasks_retried_total", reason="failure"
+                    )
+                    logger.warning(
+                        "Retrying rung dispatch %s (attempt %d/%d) in "
+                        "%.2fs, excluding worker %s",
+                        stid, task["attempt"], cfg.retry_max_attempts,
+                        backoff, wid,
+                    )
+                    record_event(
+                        "retry", job_id=job_id, subtask_id=stid,
+                        worker_id=wid, attempt=task["attempt"],
+                        reason="failure", backoff_s=backoff,
+                        failures=entry.failures,
+                        max_attempts=cfg.retry_max_attempts,
+                        error=result.get("error"),
+                    )
+                    retry_due.append((time.time() + backoff, task))
+                last_progress = time.time()
+            return results_by_id
+        finally:
+            sub.close()
+            self.cluster.ledger.forget(all_ids)
+            self.cluster.clear_cancels(all_ids)
+
+    def _apply_search_step_direct(
+        self, step: Step, results_by_id, on_result, on_intermediate, job_id
+    ) -> List[Dict[str, Any]]:
+        """Direct-mode step application; returns the fresh rung dispatches
+        for the next wave."""
+        for tid, res in step.promoted:
+            if res is not None:
+                on_intermediate(tid, res)
+        new_tasks = []
+        for task in step.new_tasks:
+            # no ledger in direct mode: bump the attempt stamp in place so
+            # rung dispatches stay distinguishable in results/journals
+            task["attempt"] = int(task.get("attempt") or 0) + 1
+            task.pop("speculative", None)
+            new_tasks.append(task)
+        if step.cancels:
+            self.executor.cancel(step.cancels)
+        for tid, status, res in step.finished:
+            results_by_id[tid] = res
+            on_result(tid, status, res)
+        return new_tasks
+
+    def _run_job_search_direct(
+        self, sid, job_id, driver: SearchJobDriver, on_result,
+        on_intermediate, on_metrics,
+    ) -> Dict[str, Dict[str, Any]]:
+        """Direct-mode rung loop: synchronous waves on the in-process
+        executor. The executor's per-batch metrics messages carry the
+        rung-boundary score; ``on_metrics`` feeds the controller DURING
+        the wave (the stop_score fast path), so cancels reach the
+        executor before its next batch boundary. Failures keep the legacy
+        direct-mode semantics (terminal, no retries) and simply drop the
+        trial off its ladder."""
+        results_by_id: Dict[str, Dict[str, Any]] = {}
+        # resume synthesis first (a resume_step never carries new tasks —
+        # dispatches come from pending_tasks below)
+        self._apply_search_step_direct(
+            driver.resume_step(), results_by_id, on_result, on_intermediate,
+            job_id,
+        )
+        tasks = driver.pending_tasks()
+        while tasks:
+            steps: List[Step] = []
+
+            def _metrics(msg):
+                step = driver.handle_metrics(msg)
+                if step.cancels:
+                    # reach the executor before its next batch boundary
+                    self.executor.cancel(step.cancels)
+                if step.finished or step.new_tasks or step.promoted:
+                    steps.append(step)
+                on_metrics(msg)
+
+            wave = self.executor.run_subtasks(tasks, on_metrics=_metrics)
+            for st, r in zip(tasks, wave):
+                stid = st["subtask_id"]
+                r = r or {}
+                status = r.get("status", "completed")
+                if status == "failed":
+                    steps.append(driver.handle_quarantine(stid, r))
+                elif status == "pruned":
+                    steps.append(driver.handle_pruned_result(stid, r))
+                else:
+                    steps.append(driver.handle_result(stid, r))
+            tasks = []
+            for step in steps:
+                tasks.extend(
+                    self._apply_search_step_direct(
+                        step, results_by_id, on_result, on_intermediate,
+                        job_id,
+                    )
+                )
+            self.store.set_search_state(sid, job_id, driver.summary())
+        if not driver.done():
+            logger.warning(
+                "Search job %s: wave loop drained with %d trials "
+                "undecided", job_id,
+                sum(1 for t in driver.specs
+                    if t not in driver.controller.decided),
+            )
+        return results_by_id
+
+    def _aggregate(self, sid, job_id, subtasks, results,
+                   search_summary: Optional[Dict[str, Any]] = None) -> None:
         """Sort completed trials by mean_cv_score desc; best_result first
         (task_handler.py:254-263). The winner is refit once and stored as a
         downloadable artifact."""
         completed = [r for r in results if r and r.get("status") == "completed"]
         failed = [r for r in results if r and r.get("status") == "failed"]
+        pruned = [r for r in results if r and r.get("status") == "pruned"]
 
         def score_key(r):
             # None survives JSON round-trips from remote agents (inf/NaN are
@@ -760,6 +1116,10 @@ class Coordinator:
             # download_best_model call (the reference eagerly pickled every
             # trial's model, worker.py:352-356 — pure overhead for searches)
             st = next(s for s in subtasks if s["subtask_id"] == best["subtask_id"])
+            if best.get("asha") and best.get("parameters"):
+                # adaptive search: refit at the winner's FINAL rung budget
+                # (the subtask list still holds the rung-0 spec)
+                st = {**st, "parameters": best["parameters"]}
             with self._artifact_lock:
                 self._artifact_specs[(sid, job_id)] = st
         final = {
@@ -768,6 +1128,16 @@ class Coordinator:
             "best_result": best,
             "completion_time": time.time(),
         }
+        if pruned or search_summary is not None:
+            # adaptive search (docs/SEARCH.md): early-stopped trials are a
+            # separate, NON-failure report — ranked by their last rung
+            # score — plus the final rung-state summary
+            final["pruned_results"] = sorted(
+                pruned, key=score_key, reverse=True
+            )
+            final["n_pruned"] = len(pruned)
+            if search_summary is not None:
+                final["search"] = search_summary
         # quarantine contract (docs/ROBUSTNESS.md): subtasks the retry
         # layer gave up on surface as a structured report, and the job
         # finalizes as ``completed_with_failures`` (partial results)

@@ -166,6 +166,13 @@ class LocalExecutor:
         #: live run_subtasks calls — the prewarm worker's yield signal
         self._inflight = 0
         self._inflight_lock = threading.Lock()
+        #: cooperative-cancel set (docs/SEARCH.md): subtask_id -> highest
+        #: cancelled attempt. Fed by the coordinator (poll response
+        #: ``cancel`` list / in-process push); consumed at the next batch
+        #: boundary — the matching trials are dropped from the batch and
+        #: posted as terminal ``pruned`` results instead of running
+        self._cancel_lock = threading.Lock()
+        self._cancelled: Dict[str, int] = {}
 
     @property
     def busy(self) -> bool:
@@ -173,6 +180,92 @@ class LocalExecutor:
         background prewarm worker (runtime/prewarm.py) polls this and
         yields the device to real placements."""
         return self._inflight > 0
+
+    def cancel(self, items) -> None:
+        """Mark attempts cancelled (the cooperative-cancel contract,
+        docs/SEARCH.md). ``items``: dicts with ``subtask_id`` (+ optional
+        ``attempt``). Matching trials still queued or batched stop at the
+        next batch boundary and post a terminal ``pruned`` result; a
+        trial already inside a fused device dispatch finishes that
+        dispatch (the rung) — cancellation is between batches, never a
+        mid-kernel abort."""
+        with self._cancel_lock:
+            for item in items or []:
+                stid = item.get("subtask_id") if isinstance(item, dict) else item
+                if not stid:
+                    continue
+                attempt = (
+                    int(item.get("attempt") or 0)
+                    if isinstance(item, dict)
+                    else 0
+                )
+                self._cancelled[stid] = max(
+                    self._cancelled.get(stid, 0), attempt
+                )
+            # bound the set: entries for subtasks this executor never sees
+            # (the cancel list is fleet-broadcast) must not accumulate for
+            # the process lifetime — active cancels re-arrive on every
+            # poll, so evicting the oldest is safe
+            while len(self._cancelled) > 4096:
+                self._cancelled.pop(next(iter(self._cancelled)))
+
+    def _take_cancelled(self, subtasks, idxs):
+        """Split a group into (live, cancelled) index lists; cancelled
+        entries are consumed from the set (a later duplicate delivery of
+        the same subtask re-arrives via the next poll's cancel list). A
+        task stamped with a HIGHER attempt than the cancel is NOT
+        cancelled — a legitimately re-issued attempt (post-restart
+        re-dispatch) must survive a stale entry."""
+        with self._cancel_lock:
+            if not self._cancelled:
+                return idxs, []
+            live, cancelled = [], []
+            for gi in idxs:
+                st = subtasks[gi]
+                stid = st["subtask_id"]
+                marked = self._cancelled.get(stid)
+                if marked is not None and int(st.get("attempt") or 0) <= marked:
+                    cancelled.append(gi)
+                    self._cancelled.pop(stid, None)
+                else:
+                    live.append(gi)
+        return live, cancelled
+
+    def _post_pruned(self, st, results, gi, on_result, on_metrics) -> None:
+        """Terminal ``pruned`` result for a cancelled attempt: the trial
+        never (re-)runs. The paired metrics message carries no timing and
+        ``cancelled: true`` so the scheduler releases the worker's books
+        WITHOUT feeding the runtime predictor or its calibration windows
+        (runtime/scheduler.on_metrics)."""
+        result = {
+            "subtask_id": st["subtask_id"],
+            "job_id": st.get("job_id"),
+            "model_type": st.get("model_type"),
+            "parameters": st.get("parameters"),
+            "status": "pruned",
+            "pruned": True,
+            "prune_reason": "cancelled",
+            "attempt": int(st.get("attempt") or 0),
+        }
+        if st.get("asha"):
+            result["asha"] = dict(st["asha"])
+        results[gi] = result
+        counter_inc("tpuml_subtasks_pruned_total")
+        logger.info(
+            "Cancelled subtask %s pruned at the batch boundary",
+            st["subtask_id"],
+        )
+        if on_result:
+            on_result(st["subtask_id"], "pruned", result)
+        if on_metrics:
+            on_metrics({
+                "worker_id": self.executor_id,
+                "subtask_id": st["subtask_id"],
+                "status": "PRUNED",
+                "cancelled": True,
+                "algo": st.get("model_type"),
+                "obs_pid": process_token(),
+            })
 
     def run_subtasks(
         self,
@@ -206,6 +299,17 @@ class LocalExecutor:
             groups.setdefault((st["dataset_id"], st["model_type"]), []).append(i)
 
         for (dataset_id, model_type), idxs in groups.items():
+            # cooperative cancel, checked at every batch boundary: trials
+            # the coordinator pruned mid-flight are dropped here and
+            # posted as terminal ``pruned`` results instead of burning
+            # the rest of their budget (docs/SEARCH.md)
+            idxs, cancelled = self._take_cancelled(subtasks, idxs)
+            for gi in cancelled:
+                self._post_pruned(
+                    subtasks[gi], results, gi, on_result, on_metrics
+                )
+            if not idxs:
+                continue
             received_at = time.time()
             # the batch rides the submitting job's trace (trace_id stamped
             # into each subtask spec by the coordinator); direct callers
@@ -370,6 +474,10 @@ class LocalExecutor:
             }
             if st.get("speculative"):
                 result["speculative"] = True
+            if st.get("asha"):
+                # rung stamp echoed so the coordinator's rung controller
+                # can attribute the score without a spec lookup race
+                result["asha"] = dict(st["asha"])
             if device_best_pos == j:
                 result["device_argmax"] = True
             if j == 0 and batch_cost is not None:
@@ -388,6 +496,7 @@ class LocalExecutor:
                         model_type, resources, run=run,
                         batch_size=len(idxs), primary=(j == 0),
                         batch_cost=batch_cost,
+                        score=run.trial_metrics[j].get("mean_cv_score"),
                     )
                 )
 
@@ -583,7 +692,7 @@ class LocalExecutor:
 
     def _metrics_message(self, st, received_at, started_at, finished_at,
                          algo, resources=None, run=None, batch_size=1,
-                         primary=False, batch_cost=None):
+                         primary=False, batch_cost=None, score=None):
         """Reference metrics schema (worker.py:233-243): CPU/mem averaged
         over the fit by the 0.5 s-cadence ResourceSampler (the predictor's
         feature inputs), plus device peak-memory — the accelerator signal
@@ -610,6 +719,21 @@ class LocalExecutor:
             # double-observe; docs/OBSERVABILITY.md)
             "obs_pid": process_token(),
         }
+        a = st.get("asha")
+        if a:
+            # rung boundary (docs/SEARCH.md): the intermediate validation
+            # score + rung/resource ride the metrics message so the
+            # coordinator's on_metrics can feed the rung controller before
+            # the result lands, and the scheduler's predictor feed can
+            # normalize the rung's wall time by its resource fraction
+            msg["rung"] = int(a.get("rung", 0))
+            msg["resource"] = int(a.get("resource", 0))
+            msg["intermediate_score"] = score
+            big = a.get("max_resource")
+            if isinstance(big, (int, float)) and big > 0:
+                msg["asha_resource_fraction"] = min(
+                    max(float(a.get("resource", 0)) / float(big), 0.01), 1.0
+                )
         if run is not None:
             # batch_-prefixed: these are totals for the WHOLE run_trials
             # batch this subtask rode in (every subtask of the batch

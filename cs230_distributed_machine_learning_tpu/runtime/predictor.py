@@ -112,15 +112,42 @@ class RuntimePredictor:
 
     # ---------------- predict / observe ----------------
 
+    @staticmethod
+    def resource_fraction(obj: Dict[str, Any]) -> float:
+        """Rung budget as a fraction of the full trial budget, for
+        adaptive-search dispatches (docs/SEARCH.md). Task specs carry an
+        ``asha`` block {resource, max_resource}; executor metrics messages
+        carry the precomputed ``asha_resource_fraction``. Exhaustive-search
+        work prices at 1.0 (unchanged behavior)."""
+        a = obj.get("asha")
+        if isinstance(a, dict):
+            r = a.get("resource")
+            big = a.get("max_resource")
+            if isinstance(r, (int, float)) and isinstance(big, (int, float)) and big > 0:
+                return min(max(float(r) / float(big), 0.01), 1.0)
+        f = obj.get("asha_resource_fraction")
+        if isinstance(f, (int, float)) and f > 0:
+            return min(max(float(f), 0.01), 1.0)
+        return 1.0
+
     def predict(self, task: Dict[str, Any]) -> float:
         feats = self.features(task)[None, :]
         with self._lock:
             est = float(self._model.predict(feats)[0])
         est = max(est, 1e-3)
         mult = self.algo_weights.get(task.get("model_type", ""), 1.0)
-        return est * mult
+        # rungs are priced by their resource so placement scores and lease
+        # deadlines reflect the SMALL budget actually dispatched — a rung-0
+        # probe must not be leased (or load-accounted) like a full trial
+        return est * mult * self.resource_fraction(task)
 
     def observe(self, task: Dict[str, Any], actual_runtime_s: float) -> None:
+        # normalize rung observations back to full-budget-equivalent cost
+        # so the model learns ONE consistent target regardless of which
+        # rung reported; predict() re-applies the dispatch's fraction
+        actual_runtime_s = float(actual_runtime_s) / self.resource_fraction(
+            task
+        )
         feats = self.features(task)
         # executor metrics messages carry the family as "algo" (reference
         # schema); synthetic/test feedback uses "model_type"

@@ -665,6 +665,14 @@ class PlacementEngine:
         actual = None
         if started is not None and finished is not None:
             actual = max(float(finished) - float(started), 1e-3)
+        # cooperative-cancel guard (docs/SEARCH.md): a cancelled/pruned
+        # attempt's message releases the worker's books below but must
+        # NEVER feed the predictor, the calibration windows, or the
+        # speed/health EWMAs — a trial stopped at rung 1 would log a
+        # wildly small "actual" against a full-budget estimate and poison
+        # the ratio every lease is derived from
+        if msg.get("cancelled"):
+            actual = None
         with self._lock:
             w = self.workers.get(wid)
             if w is None:

@@ -53,7 +53,7 @@ _DASHBOARD_HTML = """<!doctype html>
  <code>/cost/&lt;job_id&gt;</code> <code>/explain/&lt;job_id&gt;/&lt;subtask_id&gt;</code>
  <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code></div>
 <h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
-<th>status</th><th>done</th><th>failed</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
+<th>status</th><th>done</th><th>failed</th><th>pruned</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
 <h2>Latest job trace</h2>
 <div id="trace" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no trace yet</div>
 <h2>Latest job cost</h2>
@@ -215,8 +215,9 @@ async function tick(){
     <td>${esc(j.job_id)}</td><td>${esc(j.model_type)}</td><td>${esc(j.dataset_id)}</td>
     <td class="${j.status === "completed" ? "ok" : (j.status === "failed" || j.status === "completed_with_failures") ? "bad" : ""}">${esc(j.status)}</td>
     <td>${esc(j.completed_subtasks)}</td><td>${esc(j.failed_subtasks)}</td>
+    <td>${esc(j.pruned_subtasks || 0)}</td>
     <td>${esc(j.total_subtasks)}</td><td>${esc((j.session_id || "").slice(0, 8))}</td></tr>`).join("")
-    || "<tr><td colspan=8>no jobs yet</td></tr>";
+    || "<tr><td colspan=9>no jobs yet</td></tr>";
   kvTable(document.getElementById("workers"), workers);
   kvTable(document.getElementById("queues"), queues);
   listTable(document.getElementById("sup"), sup);
@@ -722,7 +723,15 @@ def create_app(coordinator: Optional[Coordinator] = None):
         cluster = _cluster_or_400()
         max_n = int(request.args.get("max", 64))
         timeout_s = float(request.args.get("timeout", 10.0))
-        return _json({"tasks": cluster.pull_tasks(wid, max_n, timeout_s)})
+        out = {"tasks": cluster.pull_tasks(wid, max_n, timeout_s)}
+        # cooperative-cancel list (docs/SEARCH.md): attempts the rung
+        # controller pruned mid-flight — the agent feeds them to its
+        # executor, which stops each at the next batch boundary and posts
+        # a terminal ``pruned`` result
+        cancels = cluster.cancel_list()
+        if cancels:
+            out["cancel"] = cancels
+        return _json(out)
 
     def task_result(request, wid):
         _cluster_or_400().push_result(wid, request.get_json(force=True))

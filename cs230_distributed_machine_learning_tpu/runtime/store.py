@@ -32,6 +32,12 @@ from ..utils.serialization import json_safe
 #: poisoned subtask.
 TERMINAL_STATUSES = ("completed", "failed", "completed_with_failures")
 
+#: per-SUBTASK terminal statuses. ``pruned`` is the adaptive-search
+#: contract (docs/SEARCH.md): a non-failure terminal state for a trial the
+#: rung controller stopped early — it counts toward job completion like
+#: ``completed`` but never toward the failure report.
+SUBTASK_TERMINAL_STATUSES = ("completed", "failed", "pruned")
+
 
 def _final_status(result) -> str:
     """Derive the terminal job status from a finalize payload."""
@@ -100,6 +106,7 @@ class JobStore:
                             "total_subtasks": job.get("total_subtasks"),
                             "completed_subtasks": job.get("completed_subtasks"),
                             "failed_subtasks": job.get("failed_subtasks"),
+                            "pruned_subtasks": job.get("pruned_subtasks", 0),
                             "created_at": job.get("created_at"),
                             "completion_time": job.get("completion_time"),
                         }
@@ -151,6 +158,7 @@ class JobStore:
             "total_subtasks": len(subtasks),
             "completed_subtasks": 0,
             "failed_subtasks": 0,
+            "pruned_subtasks": 0,
             "status": "pending",
             "subtasks": {
                 st["subtask_id"]: {"spec": json_safe(st), "status": "pending", "result": None}
@@ -174,23 +182,7 @@ class JobStore:
         with self._lock:
             job = self._require_job(sid, job_id)
             sub = job["subtasks"][subtask_id]
-            prev = sub["status"]
-            sub["status"] = status
-            if result is not None:
-                sub["result"] = json_safe(result)
-                # the attempt that delivered the accepted result — the
-                # result-ack half of the at-least-once contract: a replayed
-                # coordinator knows which attempt is already delivered
-                sub["attempt"] = int((result or {}).get("attempt") or 0)
-            if status in ("completed", "failed") and prev not in ("completed", "failed"):
-                if status == "completed":
-                    job["completed_subtasks"] += 1
-                else:
-                    job["failed_subtasks"] += 1
-            done = job["completed_subtasks"] + job["failed_subtasks"]
-            total = job["total_subtasks"]
-            if done < total:
-                job["status"] = f"{100.0 * done / total:.1f}%"
+            self._apply_subtask_update(job, sub, status, json_safe(result))
         self._journal(
             {
                 "op": "update_subtask",
@@ -202,6 +194,51 @@ class JobStore:
                 "result": json_safe(result),
             }
         )
+
+    @staticmethod
+    def _apply_subtask_update(
+        job: Dict[str, Any],
+        sub: Dict[str, Any],
+        status: str,
+        result: Optional[Dict[str, Any]],
+    ) -> None:
+        """One subtask transition, shared by the live path and journal
+        replay so both count identically. Terminal statuses (completed /
+        failed / pruned) count once toward completion; ``promoted`` (an
+        adaptive-search rung boundary, docs/SEARCH.md) stores the
+        intermediate result without counting. Any result carrying an
+        ``asha`` block is appended to the subtask's ``rung_history`` — the
+        record a restarted coordinator rebuilds rung state from."""
+        prev = sub["status"]
+        sub["status"] = status
+        if result is not None:
+            sub["result"] = result
+            # the attempt that delivered the accepted result — the
+            # result-ack half of the at-least-once contract: a replayed
+            # coordinator knows which attempt is already delivered
+            sub["attempt"] = int((result or {}).get("attempt") or 0)
+            if result.get("asha"):
+                sub.setdefault("rung_history", []).append(
+                    dict(result["asha"])
+                )
+        if (
+            status in SUBTASK_TERMINAL_STATUSES
+            and prev not in SUBTASK_TERMINAL_STATUSES
+        ):
+            if status == "completed":
+                job["completed_subtasks"] += 1
+            elif status == "pruned":
+                job["pruned_subtasks"] = job.get("pruned_subtasks", 0) + 1
+            else:
+                job["failed_subtasks"] += 1
+        done = (
+            job["completed_subtasks"]
+            + job["failed_subtasks"]
+            + job.get("pruned_subtasks", 0)
+        )
+        total = job["total_subtasks"]
+        if done < total:
+            job["status"] = f"{100.0 * done / total:.1f}%"
 
     def record_attempt(
         self,
@@ -287,7 +324,11 @@ class JobStore:
                         continue
                     jobs += 1
                     per_session[sid] = per_session.get(sid, 0) + 1
-                    done = job["completed_subtasks"] + job["failed_subtasks"]
+                    done = (
+                        job["completed_subtasks"]
+                        + job["failed_subtasks"]
+                        + job.get("pruned_subtasks", 0)
+                    )
                     pending += max(int(job["total_subtasks"]) - done, 0)
         return {
             "jobs": jobs,
@@ -338,8 +379,9 @@ class JobStore:
     def job_progress(self, sid: str, job_id: str) -> Dict[str, Any]:
         with self._lock:
             job = self._require_job(sid, job_id)
-            done = job["completed_subtasks"] + job["failed_subtasks"]
-            return {
+            pruned = job.get("pruned_subtasks", 0)
+            done = job["completed_subtasks"] + job["failed_subtasks"] + pruned
+            out = {
                 "job_status": job["status"],
                 "tasks_completed": done,
                 "tasks_pending": job["total_subtasks"] - done,
@@ -348,11 +390,27 @@ class JobStore:
                 # in flight are not terminal and do not count), final
                 # under completed_with_failures (docs/ROBUSTNESS.md)
                 "tasks_failed": job["failed_subtasks"],
+                # adaptive search (docs/SEARCH.md): trials the rung
+                # controller stopped early — non-failure terminals that
+                # ride the SSE stream so clients can show rung progress
+                "tasks_pruned": pruned,
                 "total_subtasks": job["total_subtasks"],
                 "job_result": job["result"]
                 if job["status"] in TERMINAL_STATUSES
                 else None,
             }
+            if job.get("search") is not None:
+                out["search"] = json.loads(json.dumps(job["search"]))
+            return out
+
+    def set_search_state(
+        self, sid: str, job_id: str, summary: Dict[str, Any]
+    ) -> None:
+        """Attach the live rung-state summary (AshaController.summary) to
+        the job for progress/SSE readers. Derived state — rebuilt from
+        ``rung_history`` on replay — so it is deliberately NOT journaled."""
+        with self._lock:
+            self._require_job(sid, job_id)["search"] = json_safe(summary)
 
     def unfinished_jobs(self) -> List[tuple]:
         """(sid, job_id) of jobs not yet finalized — after a journal replay
@@ -449,22 +507,14 @@ class JobStore:
                 )["jobs"][e["record"]["job_id"]] = e["record"]
             elif op == "update_subtask":
                 job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                # journals from before the adaptive-search layer have no
+                # pruned counter — seed it so the shared transition logic
+                # (and its done arithmetic) is total on old records
+                job.setdefault("pruned_subtasks", 0)
                 sub = job["subtasks"][e["stid"]]
-                prev = sub["status"]
-                sub["status"] = e["status"]
-                if e.get("result") is not None:
-                    sub["result"] = e["result"]
-                    sub["attempt"] = int(e.get("attempt", 0) or 0)
-                if e["status"] in ("completed", "failed") and prev not in (
-                    "completed",
-                    "failed",
-                ):
-                    key = (
-                        "completed_subtasks"
-                        if e["status"] == "completed"
-                        else "failed_subtasks"
-                    )
-                    job[key] += 1
+                self._apply_subtask_update(
+                    job, sub, e["status"], e.get("result")
+                )
             elif op == "subtask_attempt":
                 # fault-tolerance bookkeeping (docs/ROBUSTNESS.md):
                 # restore retry budgets / excluded-worker memory into

@@ -11,12 +11,24 @@ Semantics parity with the reference's ``create_subtasks``
   sklearn itself would try;
 - plain estimator -> a single subtask with ``base_estimator_params``.
 
+Beyond the reference: ``search_type="asha" | "hyperband"`` expands an
+adaptive-search job (docs/SEARCH.md). Each trial starts at its bracket's
+rung 0 with the small resource budget in its parameters AND
+``train_params`` ({rung, resource}); the spec's ``asha`` block carries the
+full rung state the controller (runtime/search.py) promotes/prunes
+against. Promotions later re-stamp the same subtask id with the larger
+budget as a fresh attempt.
+
 Subtask ids follow the reference's ``<job_id>-subtask-<i>`` scheme.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
+
+#: model_details.search_type values owned by the adaptive-search
+#: controller (runtime/search.py) rather than exhaustive fan-out
+ADAPTIVE_SEARCH_TYPES = ("asha", "hyperband")
 
 
 def create_subtasks(
@@ -31,37 +43,57 @@ def create_subtasks(
     model_type = model_details["model_type"]
     search_type = model_details.get("search_type")
     base_params = dict(model_details.get("base_estimator_params") or {})
+    asha_blocks: List[Optional[Dict[str, Any]]]
 
-    if search_type == "GridSearchCV":
+    if search_type in ADAPTIVE_SEARCH_TYPES:
+        from .search import plan_trials
+
+        planned = plan_trials(model_details)
+        combos = [combo for combo, _ in planned]
+        asha_blocks = [block for _, block in planned]
+    elif search_type == "GridSearchCV":
         grid = model_details.get("param_grid") or {}
         combos = list(ParameterGrid(grid))
+        asha_blocks = [None] * len(combos)
     elif search_type == "RandomizedSearchCV":
         dists = model_details.get("param_distributions") or {}
         n_iter = int(model_details.get("n_iter", 10))
         random_state = model_details.get("random_state")
         combos = list(ParameterSampler(dists, n_iter=n_iter, random_state=random_state))
+        asha_blocks = [None] * len(combos)
     else:
         combos = [{}]
+        asha_blocks = [None]
 
     cv_params = dict(model_details.get("cv_params") or {})
     subtasks = []
     for i, combo in enumerate(combos):
         params = {**base_params, **combo}
-        subtasks.append(
-            {
-                "subtask_id": f"{job_id}-subtask-{i}",
-                "job_id": job_id,
-                "session_id": session_id,
-                "dataset_id": dataset_id,
-                "model_type": model_type,
-                "parameters": params,
-                "search_params": combo,
-                "train_params": {**train_params, **cv_params},
-                # fault-tolerance bookkeeping (docs/ROBUSTNESS.md): the
-                # attempt id stamps every dispatched copy; reclaims and
-                # retries bump it through the AttemptLedger. Journals from
-                # before this field replay fine — readers default to 0.
-                "attempt": 0,
+        st = {
+            "subtask_id": f"{job_id}-subtask-{i}",
+            "job_id": job_id,
+            "session_id": session_id,
+            "dataset_id": dataset_id,
+            "model_type": model_type,
+            "parameters": params,
+            "search_params": combo,
+            "train_params": {**train_params, **cv_params},
+            # fault-tolerance bookkeeping (docs/ROBUSTNESS.md): the
+            # attempt id stamps every dispatched copy; reclaims and
+            # retries bump it through the AttemptLedger. Journals from
+            # before this field replay fine — readers default to 0.
+            "attempt": 0,
+        }
+        block = asha_blocks[i]
+        if block is not None:
+            st["asha"] = dict(block)
+            st["parameters"] = {
+                **params, block["resource_param"]: block["resource"]
             }
-        )
+            st["train_params"] = {
+                **st["train_params"],
+                "rung": block["rung"],
+                "resource": block["resource"],
+            }
+        subtasks.append(st)
     return subtasks

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos]   (default: fast)
+#   ./ci.sh [fast|kernels|chaos|search]   (default: fast)
 #
 #   fast mode:
 #   1. compileall lint gate — every .py in the package, tests, and
@@ -22,6 +22,14 @@
 #   CS230_HIST_KERNEL) end to end. A few minutes; the job that makes a
 #   TPU-kernel regression fail without a TPU. Recipe + parity
 #   contracts: docs/KERNELS.md.
+#
+#   search mode: the adaptive-search suites standalone (docs/SEARCH.md) —
+#   the ASHA/Hyperband controller unit suite plus the e2e cluster runs
+#   (prune mid-flight, degenerate-eta winner parity, the rung
+#   journal-replay drill), then the committed adaptive-search benchmark
+#   (ASHA vs exhaustive RandomizedSearch on the covertype config; gate:
+#   score parity ±1e-3 AND <= 0.5x device-seconds) which refreshes
+#   benchmarks/ADAPTIVE_SEARCH.json into bench-artifacts/.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -69,6 +77,25 @@ if [ "$MODE" = "kernels" ]; then
     tests/test_pallas_mlp.py tests/test_pallas_knn.py \
     -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || rc=$?
+elif [ "$MODE" = "search" ]; then
+  echo "== adaptive-search suite (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_search_asha.py tests/test_search_e2e.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  echo "== adaptive-search benchmark (device-seconds gate) =="
+  mkdir -p bench-artifacts
+  if JAX_PLATFORMS=cpu python benchmarks/adaptive_search.py \
+      > bench-artifacts/adaptive_search.log 2>&1; then
+    cp benchmarks/ADAPTIVE_SEARCH.json bench-artifacts/ || true
+    tail -n 1 bench-artifacts/adaptive_search.log
+  else
+    echo "adaptive_search FAILED (see bench-artifacts/adaptive_search.log)"
+    rc=1
+  fi
 elif [ "$MODE" = "chaos" ]; then
   echo "== chaos/durability suite (JAX_PLATFORMS=cpu, -m slow) =="
   CS230_JOURNAL_DIR="$ART_DIR/journal" \

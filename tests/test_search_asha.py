@@ -1,0 +1,497 @@
+"""Adaptive-search rung controller (docs/SEARCH.md): ladder/bracket math,
+async promotion, terminal prunes, out-of-order/duplicate report handling,
+bracket allocation, subtask expansion, rung-resource predictor pricing,
+the cancelled-attempt calibration guard, and the store's ``pruned`` /
+``promoted`` status plumbing."""
+
+import time
+
+import pytest
+
+from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+from cs230_distributed_machine_learning_tpu.runtime.predictor import RuntimePredictor
+from cs230_distributed_machine_learning_tpu.runtime.scheduler import PlacementEngine
+from cs230_distributed_machine_learning_tpu.runtime.search import (
+    AshaController,
+    SearchJobDriver,
+    asha_schedule,
+    build_controller,
+    hyperband_brackets,
+    plan_trials,
+    resource_param_for,
+)
+from cs230_distributed_machine_learning_tpu.runtime.store import (
+    SUBTASK_TERMINAL_STATUSES,
+    JobStore,
+)
+from cs230_distributed_machine_learning_tpu.runtime.subtasks import create_subtasks
+
+
+# ---------------- ladder / bracket math ----------------
+
+
+def test_asha_schedule_geometric_and_degenerate():
+    assert asha_schedule(10, 270, 3) == [10, 30, 90, 270]
+    assert asha_schedule(10, 100, 3) == [10, 30, 100]
+    # min >= max degenerates to a single full-budget rung
+    assert asha_schedule(100, 100, 3) == [100]
+    assert asha_schedule(200, 100, 3) == [100]
+
+
+def test_hyperband_bracket_allocation():
+    brackets = hyperband_brackets(81, 3)
+    assert [b["bracket"] for b in brackets] == [4, 3, 2, 1, 0]
+    # most-exploratory bracket: many trials at unit resource; the
+    # exploitation bracket runs few trials at the full budget
+    assert brackets[0]["min_resource"] == 1 and brackets[0]["n_trials"] == 81
+    assert brackets[-1]["min_resource"] == 81
+    capped = hyperband_brackets(81, 3, max_brackets=2, n_trials=20)
+    assert len(capped) == 2
+    assert sum(b["n_trials"] for b in capped) == pytest.approx(20, abs=2)
+
+
+def test_resource_param_mapping_and_rejection():
+    assert resource_param_for("LogisticRegression") == "max_iter"
+    assert resource_param_for("GradientBoostingClassifier") == "n_estimators"
+    with pytest.raises(ValueError, match="resource budget"):
+        resource_param_for("KNeighborsClassifier")
+
+
+# ---------------- async promotion ----------------
+
+
+def _ctrl(n=9, eta=3, **kw):
+    kw.setdefault("min_resource", 10)
+    kw.setdefault("max_resource", 90)
+    return AshaController([f"t{i}" for i in range(n)], eta=eta, **kw)
+
+
+def _actions(decisions, kind):
+    return [d["trial_id"] for d in decisions if d["action"] == kind]
+
+
+def test_promotes_the_moment_top_one_over_eta_of_reported():
+    c = _ctrl()
+    assert c.on_report("t0", 0, 0.5) == []  # 1 reported: floor(1/3) = 0
+    assert c.on_report("t1", 0, 0.4) == []
+    ds = c.on_report("t2", 0, 0.3)  # 3 reported -> one promotion, best wins
+    assert _actions(ds, "promote") == ["t0"]
+    assert ds[0]["to_rung"] == 1 and ds[0]["to_resource"] == 30
+    # no rung barrier: t0 promoted while 6 peers have not even reported
+    assert c.trial_rung["t0"] == 1
+
+
+def test_outranked_trials_prune_terminally():
+    c = _ctrl(n=9)
+    # max promotions out of rung 0 is capacity(rung1) = 3: once a trial's
+    # rank among reported exceeds 3 it can NEVER be promoted
+    ds = []
+    for i, score in enumerate([0.9, 0.8, 0.7, 0.6]):
+        ds += c.on_report(f"t{i}", 0, score)
+    assert "t3" in _actions(ds, "prune") or c.decided.get("t3") != "pruned"
+    for i, score in enumerate([0.5, 0.4], start=4):
+        ds += c.on_report(f"t{i}", 0, score)
+    assert c.decided.get("t3") == "pruned"  # rank 4 > max 3
+    pruned = [d for d in ds if d["action"] == "prune" and d["trial_id"] == "t3"]
+    assert pruned and pruned[0]["reason"] == "outranked"
+
+
+def test_rung_closure_prunes_remainder_and_cascades():
+    c = _ctrl(n=4, eta=3)  # rungs: cap 4 -> 1 -> 1 over [10, 30, 90]
+    ds = []
+    for i, score in enumerate([0.4, 0.3, 0.2, 0.1]):
+        ds += c.on_report(f"t{i}", 0, score)
+    # closure: every entrant reported -> best promoted, rest pruned
+    assert _actions(ds, "promote") == ["t0"]
+    assert set(_actions(ds, "prune")) == {"t1", "t2", "t3"}
+    # single-entrant rung still climbs (closure promotes at least one)
+    ds2 = c.on_report("t0", 1, 0.5)
+    assert _actions(ds2, "promote") == ["t0"]
+    ds3 = c.on_report("t0", 2, 0.6)
+    assert _actions(ds3, "complete") == ["t0"]
+    assert c.is_complete()
+
+
+def test_out_of_order_and_duplicate_reports_are_idempotent():
+    c = _ctrl(n=4, eta=3)
+    for i, score in enumerate([0.4, 0.3, 0.2, 0.1]):
+        c.on_report(f"t{i}", 0, score)
+    assert c.on_report("t0", 0, 0.9) == []  # duplicate: ignored, rank kept
+    assert c.on_report("t1", 0, 0.9) == []  # decided: ignored
+    assert c.on_report("t0", 0, 0.9) == []  # stale rung (t0 now at rung 1)
+    assert c.on_report("ghost", 0, 0.9) == []  # foreign trial
+    assert c.rungs[0].reported["t0"] == 0.4
+    # a rung the trial never entered
+    assert c.on_report("t0", 2, 0.9) == []
+    assert c.trial_rung["t0"] == 1
+
+
+def test_failed_trial_leaves_ladder_and_unblocks_closure():
+    c = _ctrl(n=4, eta=3)  # max 1 promotion out of rung 0
+    for i, score in enumerate([0.4, 0.3, 0.2]):
+        c.on_report(f"t{i}", 0, score)
+    # t1/t2 already outranked terminally; t0 unpromoted (quota floor(3/3)=1
+    # only opens if it is top-1 — it is, so it promoted eagerly)
+    assert c.decided.get("t1") == "pruned" and c.decided.get("t2") == "pruned"
+    assert "t0" not in c.decided
+    ds = c.on_trial_failed("t3")
+    assert c.decided["t3"] == "failed"
+    # rung 0 resolved for the survivors; t0 owes its rung-1 dispatch
+    assert c.pending_rungs() == {"t0": (1, 30)}
+    assert not _actions(ds, "prune")
+
+
+def test_stop_score_completes_winner_and_prunes_the_field():
+    c = _ctrl(n=4, eta=3, stop_score=0.95)
+    c.on_report("t1", 0, 0.5)
+    ds = c.on_report("t0", 0, 0.99)
+    assert _actions(ds, "complete") == ["t0"]
+    assert set(_actions(ds, "prune")) == {"t1", "t2", "t3"}
+    assert c.stopped and c.is_complete()
+    # post-stop reports are ignored
+    assert c.on_report("t2", 0, 1.0) == []
+
+
+def test_degenerate_single_rung_never_prunes():
+    c = AshaController(
+        [f"t{i}" for i in range(5)], min_resource=100, max_resource=100, eta=3
+    )
+    ds = []
+    for i in range(5):
+        ds += c.on_report(f"t{i}", 0, 0.1 * i)
+    assert len(_actions(ds, "complete")) == 5
+    assert not _actions(ds, "prune")
+    assert c.is_complete()
+
+
+def test_force_decide_is_first_wins():
+    c = _ctrl(n=4, eta=3)
+    c.force_decide("t0", "pruned")
+    assert c.decided["t0"] == "pruned"
+    assert c.force_decide("t0", "completed") == []
+    assert c.decided["t0"] == "pruned"
+    assert c.on_report("t0", 0, 0.9) == []
+
+
+def test_pending_rungs_tracks_unreported_current_rungs():
+    c = _ctrl(n=4, eta=3)
+    assert set(c.pending_rungs()) == {"t0", "t1", "t2", "t3"}
+    for i, score in enumerate([0.4, 0.3, 0.2, 0.1]):
+        c.on_report(f"t{i}", 0, score)
+    assert c.pending_rungs() == {"t0": (1, 30)}
+
+
+# ---------------- expansion ----------------
+
+
+def _asha_details(**asha):
+    return {
+        "model_type": "LogisticRegression",
+        "search_type": "asha",
+        "base_estimator_params": {},
+        "param_grid": {"C": [0.1, 1.0, 10.0]},
+        "n_iter": 3,
+        "asha": asha,
+    }
+
+
+def test_create_subtasks_stamps_rung_state():
+    details = _asha_details(eta=3, min_resource=20, max_resource=180)
+    subtasks = create_subtasks("j", "s", "iris", details, {"cv": 3})
+    assert len(subtasks) == 3
+    for st in subtasks:
+        a = st["asha"]
+        assert a["rung"] == 0 and a["resource"] == 20
+        assert a["max_resource"] == 180 and a["eta"] == 3
+        assert a["resource_param"] == "max_iter"
+        # the resource knob is controller-owned and stamped into params
+        assert st["parameters"]["max_iter"] == 20
+        assert st["train_params"]["rung"] == 0
+        assert st["train_params"]["resource"] == 20
+
+
+def test_plan_trials_drops_sampled_resource_param():
+    details = _asha_details(eta=3, min_resource=10, max_resource=90)
+    details["param_grid"] = {"C": [1.0], "max_iter": [500]}
+    details["n_iter"] = 1
+    (combo, block), = plan_trials(details)
+    assert "max_iter" not in combo
+    assert block["resource"] == 10
+
+
+def test_hyperband_expansion_spans_brackets():
+    details = _asha_details(eta=3, max_resource=27)
+    details["search_type"] = "hyperband"
+    details["param_distributions"] = {"C": [0.1, 1.0, 10.0, 100.0]}
+    del details["param_grid"]
+    details["n_iter"] = 12
+    subtasks = create_subtasks("j", "s", "iris", details, {})
+    brackets = {st["asha"]["bracket"] for st in subtasks}
+    assert len(brackets) >= 2
+    # controllers rebuild per bracket from the specs alone
+    ctrl = build_controller(subtasks)
+    assert set(ctrl.brackets) == brackets
+    assert ctrl.summary()["n_trials"] == len(subtasks)
+
+
+def test_unsupported_family_rejected_at_expansion():
+    details = _asha_details()
+    details["model_type"] = "GaussianNB"
+    with pytest.raises(ValueError, match="resource budget"):
+        create_subtasks("j", "s", "iris", details, {})
+
+
+# ---------------- driver (report ingest) ----------------
+
+
+def _driver(n=4, eta=3, **asha):
+    details = _asha_details(eta=eta, min_resource=10, max_resource=90, **asha)
+    details["param_grid"] = {"C": [0.1 * (i + 1) for i in range(n)]}
+    details["n_iter"] = n
+    return SearchJobDriver(create_subtasks("j", "s", "iris", details, {}))
+
+
+def _result(st, score, tt=1.0):
+    return {
+        "subtask_id": st["subtask_id"],
+        "job_id": "j",
+        "status": "completed",
+        "mean_cv_score": score,
+        "training_time": tt,
+        "asha": dict(st["asha"]),
+        "attempt": int(st.get("attempt") or 0),
+    }
+
+
+def test_driver_promotion_restamps_spec_with_larger_budget():
+    d = _driver(n=4)
+    tasks = d.pending_tasks()
+    assert len(tasks) == 4
+    steps = [
+        d.handle_result(t["subtask_id"], _result(t, score))
+        for t, score in zip(tasks, [0.4, 0.3, 0.2, 0.1])
+    ]
+    new = [t for s in steps for t in s.new_tasks]
+    assert len(new) == 1
+    task = new[0]
+    assert task["asha"]["rung"] == 1 and task["asha"]["resource"] == 30
+    assert task["parameters"]["max_iter"] == 30
+    # warm-start handoff points at the trial's own lower-rung fit
+    assert task["asha"]["warm_from"]["rung"] == 0
+    finished = {tid for s in steps for tid, _, _ in s.finished}
+    assert len(finished) == 3  # the three pruned peers
+    assert task["subtask_id"] not in finished  # the promoted one lives on
+
+
+def test_driver_duplicate_result_not_rejournaled():
+    d = _driver(n=4)
+    tasks = d.pending_tasks()
+    r = _result(tasks[0], 0.4)
+    step1 = d.handle_result(tasks[0]["subtask_id"], r)
+    assert r["asha"]["report"] is True
+    dup = _result(tasks[0], 0.4)
+    step2 = d.handle_result(tasks[0]["subtask_id"], dup)
+    # the duplicate is not absorbed: no report stamp, no emissions
+    assert "report" not in dup["asha"]
+    assert not step2.finished and not step2.new_tasks and not step2.promoted
+    assert step1 is not step2
+
+
+def test_driver_stop_score_cancels_inflight_peers():
+    d = _driver(n=4, stop_score=0.9)
+    tasks = d.pending_tasks()
+    step = d.handle_result(tasks[0]["subtask_id"], _result(tasks[0], 0.95))
+    done = {tid: status for tid, status, _ in step.finished}
+    assert done[tasks[0]["subtask_id"]] == "completed"
+    assert sorted(v for k, v in done.items() if k != tasks[0]["subtask_id"]) \
+        == ["pruned", "pruned", "pruned"]
+    # the three unreported peers had dispatches in flight -> cancelled
+    assert len(step.cancels) == 3
+    assert d.done()
+
+
+def test_driver_resume_replays_without_double_promotion():
+    d1 = _driver(n=4)
+    tasks = d1.pending_tasks()
+    results, terminal = {}, {}
+    for t, score in zip(tasks, [0.4, 0.3, 0.2, 0.1]):
+        r = _result(t, score)
+        step = d1.handle_result(t["subtask_id"], r)
+        results[t["subtask_id"]] = r  # handle_result patched its asha
+        for tid, status, _ in step.finished:
+            terminal[tid] = status
+    # the journaled job record mid-ladder: rung-0 reports written, the
+    # promotion's rung-1 dispatch in flight (no rung-1 report yet)
+    record = {
+        "subtasks": {
+            t["subtask_id"]: {
+                "status": terminal.get(t["subtask_id"], "promoted"),
+                "rung_history": [dict(results[t["subtask_id"]]["asha"])],
+            }
+            for t in tasks
+        }
+    }
+    d2 = _driver(n=4)
+    d2.resume(record)
+    # same promotion re-derived, not doubled; only the rung-1 dispatch owed
+    pend = d2.pending_tasks()
+    assert len(pend) == 1
+    assert pend[0]["asha"]["rung"] == 1
+    assert d2.controller.summary()["pruned"] == 3
+    # the resume step has nothing to synthesize (terminals all journaled)
+    assert d2.resume_step().finished == []
+
+
+def test_plan_trials_runs_full_grid_without_n_iter():
+    """A param_grid is never silently truncated: with no explicit n_iter,
+    asha expands every combo (exhaustive-GridSearchCV parity)."""
+    details = _asha_details(eta=3, min_resource=10, max_resource=90)
+    details["param_grid"] = {"C": [0.1 * (i + 1) for i in range(27)]}
+    del details["n_iter"]
+    assert len(plan_trials(details)) == 27
+    details["n_iter"] = 5  # explicit cap still honored
+    assert len(plan_trials(details)) == 5
+
+
+def test_driver_worker_pruned_result_unblocks_rung_closure():
+    """A worker-side pruned terminal the coordinator never decided (stale
+    executor cancel entry after a restart) must remove the trial from its
+    rung so the surviving peers' closure still resolves."""
+    d = _driver(n=4)
+    tasks = d.pending_tasks()
+    # three peers report; the rung stays open waiting on the fourth
+    for t, score in zip(tasks[:3], [0.4, 0.3, 0.2]):
+        d.handle_result(t["subtask_id"], _result(t, score))
+    # t0 promoted eagerly, t1/t2 pruned (outranked); rung 0 still open —
+    # the fourth entrant arrives as a worker-side pruned terminal instead
+    # of a report
+    step = d.handle_pruned_result(
+        tasks[3]["subtask_id"],
+        {"subtask_id": tasks[3]["subtask_id"], "status": "pruned"},
+    )
+    done = {tid for tid, _, _ in step.finished}
+    assert tasks[3]["subtask_id"] in done
+    # closure proceeded: every trial decided, nothing wedged
+    assert d.controller.is_complete() or d.controller.pending_rungs()
+
+
+def test_executor_cancel_respects_attempt_stamp():
+    """A task re-issued under a HIGHER attempt must survive a stale cancel
+    entry for an older attempt (post-restart re-dispatch)."""
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+
+    ex = LocalExecutor(executor_id="t")
+    ex.cancel([{"subtask_id": "s1", "attempt": 1}])
+    subtasks = [
+        {"subtask_id": "s1", "attempt": 2},   # newer attempt: survives
+        {"subtask_id": "s1", "attempt": 1},
+    ]
+    live, cancelled = ex._take_cancelled(subtasks, [0])
+    assert live == [0] and cancelled == []
+    live, cancelled = ex._take_cancelled(subtasks, [1])
+    assert live == [] and cancelled == [1]
+    # consumed: the entry is gone until the next poll re-adds it
+    live, cancelled = ex._take_cancelled(subtasks, [1])
+    assert live == [1]
+
+
+# ---------------- predictor pricing + calibration guard ----------------
+
+
+def test_predictor_prices_rungs_by_resource_fraction():
+    p = RuntimePredictor()
+    task = {"model_type": "LogisticRegression", "metadata": {"n_rows": 1000}}
+    full = p.predict(task)
+    rung = p.predict(
+        {**task, "asha": {"resource": 10, "max_resource": 100}}
+    )
+    assert rung == pytest.approx(0.1 * full)
+    # fraction clamps: zero/negative resources never zero the lease
+    tiny = p.predict({**task, "asha": {"resource": 0, "max_resource": 100}})
+    assert tiny >= 0.01 * full * 0.99
+
+
+def test_predictor_observe_normalizes_rung_walls():
+    p = RuntimePredictor(refit_batch=10 ** 9)
+    msg = {"model_type": "LogisticRegression",
+           "asha_resource_fraction": 0.1}
+    p.observe(msg, 1.0)
+    # stored as full-budget-equivalent: 1.0 s at 10% budget -> 10 s
+    feats, actual = p._history[-1]
+    assert actual == pytest.approx(10.0)
+
+
+def test_cancelled_metrics_release_books_without_poisoning_calibration():
+    """Pinned guard (ISSUE satellite): a cancelled attempt's message must
+    release the worker's books but never feed record_calibration / the
+    speed EWMA — a rung-1 wall against a full-run estimate would poison
+    the ratio leases are derived from."""
+    eng = PlacementEngine()
+    wid = eng.subscribe()
+    eng.place({"subtask_id": "c-s1", "job_id": "c-j1",
+               "model_type": "LogisticRegression", "mem_estimate_mb": 1.0})
+    now = time.time()
+    speed_before = eng.workers[wid].speed_factor
+    eng.on_metrics({"worker_id": wid, "subtask_id": "c-s1",
+                    "algo": "LogisticRegression", "cancelled": True,
+                    "started_at": now - 0.01, "finished_at": now})
+    # books released: the queue entry and load reservation are gone
+    assert eng.workers[wid].load_seconds == 0.0
+    assert not eng.workers[wid].tasks_queue
+    # predictor untouched
+    assert eng.predictor.calibration_report() == {}
+    assert eng.workers[wid].speed_factor == speed_before
+    assert eng.workers[wid].ewma_batch_s is None
+
+
+# ---------------- store plumbing ----------------
+
+
+def test_store_counts_pruned_and_replays_rung_history(tmp_path):
+    jd = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    subtasks = [{"subtask_id": f"j-subtask-{i}"} for i in range(3)]
+    store.create_job(sid, "j", {}, subtasks)
+    asha = {"rung": 0, "resource": 10, "score": 0.5, "seq": 1,
+            "report": True}
+    store.update_subtask(sid, "j", "j-subtask-0", "promoted",
+                         {"status": "completed", "asha": asha})
+    prog = store.job_progress(sid, "j")
+    assert prog["tasks_completed"] == 0  # promoted is NOT terminal
+    store.update_subtask(sid, "j", "j-subtask-0", "completed",
+                         {"status": "completed", "mean_cv_score": 0.9,
+                          "asha": {**asha, "rung": 1, "seq": 2}})
+    store.update_subtask(sid, "j", "j-subtask-1", "pruned",
+                         {"status": "pruned", "asha": {**asha, "seq": 3}})
+    store.update_subtask(sid, "j", "j-subtask-2", "failed",
+                         {"status": "failed"})
+    prog = store.job_progress(sid, "j")
+    assert prog["tasks_completed"] == 3 and prog["tasks_pruned"] == 1
+    assert prog["tasks_failed"] == 1
+    assert "pruned" in SUBTASK_TERMINAL_STATUSES
+    # double terminal transition does not double count
+    store.update_subtask(sid, "j", "j-subtask-1", "pruned",
+                         {"status": "pruned"})
+    assert store.job_progress(sid, "j")["tasks_pruned"] == 1
+
+    replayed = JobStore(journal_dir=jd)
+    job = replayed.get_job(sid, "j")
+    assert job["pruned_subtasks"] == 1 and job["completed_subtasks"] == 1
+    hist = job["subtasks"]["j-subtask-0"]["rung_history"]
+    assert [h["seq"] for h in hist] == [1, 2]
+    p2 = replayed.job_progress(sid, "j")
+    assert p2["tasks_pruned"] == 1 and p2["tasks_completed"] == 3
+
+
+def test_store_search_state_rides_progress_unjournaled(tmp_path):
+    jd = str(tmp_path / "journal")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(sid, "j", {}, [{"subtask_id": "j-subtask-0"}])
+    store.set_search_state(sid, "j", {"pruned": 2, "rungs": []})
+    assert store.job_progress(sid, "j")["search"]["pruned"] == 2
+    # derived state: rebuilt from rung history, deliberately not journaled
+    assert "search" not in JobStore(journal_dir=jd).get_job(sid, "j")

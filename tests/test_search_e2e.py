@@ -1,0 +1,326 @@
+"""End-to-end adaptive search (docs/SEARCH.md): multi-worker ASHA jobs on
+a live cluster — rung promotion/pruning, the cooperative-cancel path
+(stop_score mid-flight), degenerate-eta winner parity with exhaustive
+search, hyperband brackets, and the journal-replay drill proving a
+restarted coordinator resumes rung state without double-promoting."""
+
+import json
+import os
+import time
+from collections import Counter
+
+import pytest
+
+from cs230_distributed_machine_learning_tpu import MLTaskManager
+from cs230_distributed_machine_learning_tpu.obs import RECORDER, REGISTRY
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.executor import (
+    FaultInjector,
+    LocalExecutor,
+)
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+C_GRID = [0.001, 0.01, 0.1, 1.0, 10.0, 100.0, 0.3, 3.0, 30.0]
+
+
+@pytest.fixture()
+def search_cfg():
+    cfg = get_config()
+    cfg.scheduler.heartbeat_interval_s = 0.1
+    cfg.scheduler.sweep_interval_s = 0.2
+    cfg.scheduler.speculative_enabled = False
+    cfg.scheduler.retry_backoff_s = 0.05
+    return cfg
+
+
+def _asha_job(n=9, **asha):
+    asha.setdefault("eta", 3)
+    asha.setdefault("min_resource", 20)
+    asha.setdefault("max_resource", 180)
+    return {
+        "model_type": "LogisticRegression",
+        "search_type": "asha",
+        "base_estimator_params": {},
+        "param_grid": {"C": C_GRID[:n]},
+        "cv_params": {"cv": 3},
+        "n_iter": n,
+        "asha": asha,
+    }
+
+
+def _counter(name, **labels):
+    return REGISTRY.counter(name).value(**labels)
+
+
+def test_asha_multiworker_job_prunes_promotes_and_completes(search_cfg):
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        promoted0 = _counter("tpuml_trials_promoted_total")
+        pruned0 = _counter("tpuml_trials_pruned_total")
+        saved0 = _counter("tpuml_device_seconds_saved_total")
+        status = m.train(_asha_job(), "iris", show_progress=False,
+                         timeout=300)
+        assert status["job_status"] == "completed"
+        jr = status["job_result"]
+        # every trial reaches exactly one NON-failure terminal state
+        assert len(jr["results"]) + jr["n_pruned"] == 9
+        assert jr["failed"] == []
+        ids = [r["subtask_id"] for r in jr["results"] + jr["pruned_results"]]
+        assert len(set(ids)) == 9, "duplicate terminal result rows"
+        # the winner trained at the FULL budget
+        best = jr["best_result"]
+        assert best["parameters"]["max_iter"] == 180
+        assert best["asha"]["rung"] == 2
+        # rung summary rode the final result
+        s = jr["search"]
+        assert s["completed"] >= 1 and s["pruned"] >= 6
+        rungs = s["brackets"][0]["rungs"]
+        assert [r["resource"] for r in rungs] == [20, 60, 180]
+        assert rungs[0]["reported"] == 9
+        # progress carried the pruned count (SSE payload parity)
+        prog = coord.store.job_progress(m.session_id, m.job_id)
+        assert prog["tasks_pruned"] == jr["n_pruned"]
+        # flight recorder + counters (ISSUE satellite)
+        events = RECORDER.events(limit=10 ** 6)[0]
+        promotes = [e for e in events if e["kind"] == "rung.promote"
+                    and e["job_id"] == m.job_id]
+        prunes = [e for e in events if e["kind"] == "rung.prune"
+                  and e["job_id"] == m.job_id]
+        assert promotes and prunes
+        for e in promotes:
+            assert e["data"]["score"] is not None
+            assert e["data"]["peers"] >= 1
+            assert e["data"]["to_resource"] > e["data"]["resource"]
+        # no trial promoted twice into the same rung
+        seen = Counter((e["subtask_id"], e["data"]["to_rung"])
+                       for e in promotes)
+        assert all(n == 1 for n in seen.values())
+        assert _counter("tpuml_trials_promoted_total") - promoted0 == len(promotes)
+        assert _counter("tpuml_trials_pruned_total") - pruned0 == jr["n_pruned"]
+        assert _counter("tpuml_device_seconds_saved_total") > saved0
+    finally:
+        cluster.shutdown()
+
+
+def test_asha_stop_score_cancels_inflight_trials(search_cfg):
+    """Prune mid-flight: a slow worker still owns rung-0 trials when the
+    fast worker's trial hits stop_score — the controller cancels them
+    cooperatively (trial.cancel -> executor prunes at its next batch
+    boundary) instead of waiting out the doomed budget."""
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        slow = LocalExecutor(
+            executor_id="tmp", max_trials_per_batch=1,
+            fault_injector=FaultInjector(delay_s=3.0),
+        )
+        cluster.add_executor(executor=slow)
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        t0 = time.time()
+        status = m.train(
+            _asha_job(n=6, min_resource=50, max_resource=150,
+                      stop_score=0.9),
+            "iris", show_progress=False, timeout=300,
+        )
+        wall = time.time() - t0
+        assert status["job_status"] == "completed"
+        jr = status["job_result"]
+        assert jr["best_result"]["mean_cv_score"] >= 0.9
+        assert jr["n_pruned"] >= 1
+        assert any(r.get("prune_reason") == "stop_score"
+                   for r in jr["pruned_results"])
+        cancels = [e for e in RECORDER.events(limit=10 ** 6)[0]
+                   if e["kind"] == "trial.cancel"
+                   and e["job_id"] == m.job_id]
+        assert cancels, "no cooperative cancel issued"
+        # the job never waited for the slow worker's remaining full-budget
+        # trials (6 x 3 s of delays): the stop ended it early
+        assert wall < 12.0
+    finally:
+        cluster.shutdown()
+
+
+def test_asha_degenerate_eta_matches_exhaustive_winner(search_cfg):
+    """min_resource == max_resource collapses the ladder to one full-
+    budget rung: nothing is pruned before the full budget and the winner
+    must match exhaustive GridSearchCV bit-for-bit."""
+    from sklearn.linear_model import LogisticRegression
+    from sklearn.model_selection import GridSearchCV
+
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        grid = {"C": [0.01, 0.1, 1.0, 10.0]}
+        exhaustive = m.train(
+            GridSearchCV(LogisticRegression(max_iter=120), grid, cv=3),
+            "iris", show_progress=False, timeout=300,
+        )
+        job = _asha_job(n=4, min_resource=120, max_resource=120)
+        job["param_grid"] = grid
+        adaptive = m.train(job, "iris", show_progress=False, timeout=300)
+        jr = adaptive["job_result"]
+        assert jr["n_pruned"] == 0
+        assert len(jr["results"]) == 4
+        ex_best = exhaustive["job_result"]["best_result"]
+        ad_best = jr["best_result"]
+        assert ad_best["parameters"]["C"] == ex_best["parameters"]["C"]
+        assert ad_best["mean_cv_score"] == pytest.approx(
+            ex_best["mean_cv_score"], abs=1e-9
+        )
+    finally:
+        cluster.shutdown()
+
+
+def test_hyperband_brackets_run_to_completion(search_cfg):
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        job = _asha_job(n=6, eta=3, max_resource=90)
+        job["search_type"] = "hyperband"
+        job["asha"]["max_brackets"] = 2
+        job["param_distributions"] = {"C": C_GRID}
+        del job["param_grid"]
+        status = m.train(job, "iris", show_progress=False, timeout=300)
+        assert status["job_status"] == "completed"
+        jr = status["job_result"]
+        brackets = jr["search"]["brackets"]
+        assert len(brackets) == 2
+        # the exploitation bracket starts at a bigger budget than the
+        # exploratory one
+        assert brackets[0]["rungs"][0]["resource"] != \
+            brackets[1]["rungs"][0]["resource"]
+        assert jr["best_result"] is not None
+    finally:
+        cluster.shutdown()
+
+
+def test_asha_resume_before_any_terminal_replays_reports(search_cfg):
+    """Crash BEFORE the first prune/complete: the journal holds only
+    rung-0 reports (non-terminal ``promoted`` writes). The restarted
+    coordinator must still rebuild rung state from them — reported rungs
+    are not re-run, and no (trial, rung) gains a second report entry."""
+    from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    jd = get_config().storage.journal_dir
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    details = _asha_job(n=4, min_resource=60, max_resource=180)
+    subtasks = create_subtasks("jobr", sid, "iris", details, {"cv": 3})
+    store.create_job(
+        sid, "jobr",
+        {"dataset_id": "iris", "model_details": details, "train_params": {}},
+        subtasks,
+    )
+    # two rung-0 reports journaled as non-terminal writes, then SIGKILL
+    for seq, (st, score) in enumerate(zip(subtasks[:2], [0.9, 0.8]), 1):
+        store.update_subtask(
+            sid, "jobr", st["subtask_id"], "promoted",
+            {"subtask_id": st["subtask_id"], "status": "completed",
+             "mean_cv_score": score, "training_time": 0.1, "attempt": 0,
+             "asha": {**st["asha"], "score": score, "seq": seq,
+                      "report": True}},
+        )
+    del store
+
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster, journal=True)
+        assert coord.store.wait_job(sid, "jobr", timeout=300)
+        status = coord.check_status(sid, "jobr")
+        assert status["job_status"] == "completed"
+        job = coord.store.get_job(sid, "jobr")
+        for stid, sub in job["subtasks"].items():
+            reports = Counter(
+                h.get("rung") for h in sub.get("rung_history", [])
+                if h.get("report")
+            )
+            assert all(n == 1 for n in reports.values()), (stid, reports)
+        # the pre-crash reports were adopted, not re-measured: the two
+        # journaled scores survive as rung-0 truth
+        h0 = job["subtasks"][subtasks[0]["subtask_id"]]["rung_history"]
+        assert [h["score"] for h in h0 if h.get("rung") == 0 and h.get("report")] == [0.9]
+    finally:
+        cluster.shutdown()
+
+
+def test_asha_journal_replay_resumes_rungs_without_double_promotion(
+    search_cfg, tmp_path
+):
+    """The coordinator-death drill for rungs: run an ASHA job journaled,
+    cut the journal mid-ladder (the SIGKILL point), boot a fresh
+    coordinator on it, and prove the resumed job (a) completes, (b)
+    re-derives the same winner, and (c) never journals a second report
+    or promotion for a (trial, rung) the first life already decided."""
+    cluster = ClusterRuntime()
+    sid = jid = None
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster, journal=True)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(_asha_job(), "iris", show_progress=False,
+                         timeout=300)
+        assert status["job_status"] == "completed"
+        best1 = status["job_result"]["best_result"]
+        sid, jid = m.session_id, m.job_id
+    finally:
+        cluster.shutdown()
+
+    # cut the journal a few rung reports in: the restarted coordinator
+    # sees a half-climbed ladder plus in-flight placements
+    jp = os.path.join(get_config().storage.journal_dir, "jobs.jsonl")
+    lines = open(jp).read().splitlines()
+    keep, n_updates = [], 0
+    for ln in lines:
+        keep.append(ln)
+        if json.loads(ln).get("op") == "update_subtask":
+            n_updates += 1
+            if n_updates >= 8:
+                break
+    assert n_updates >= 8, "journal too short to cut mid-ladder"
+    with open(jp, "w") as f:
+        f.write("\n".join(keep) + "\n")
+
+    cluster2 = ClusterRuntime()
+    try:
+        cluster2.add_executor()
+        coord2 = Coordinator(cluster=cluster2, journal=True)
+        assert coord2.recovery["jobs_resumed"] == 1
+        assert coord2.store.wait_job(sid, jid, timeout=300)
+        status2 = coord2.check_status(sid, jid)
+        assert status2["job_status"] == "completed"
+        jr2 = status2["job_result"]
+        assert jr2["best_result"]["parameters"] == best1["parameters"]
+        assert jr2["best_result"]["mean_cv_score"] == pytest.approx(
+            best1["mean_cv_score"], abs=1e-9
+        )
+        # rung-state invariant: across BOTH lives, every (trial, rung)
+        # has at most one absorbed execution report — the journal is the
+        # union of both lives' writes, so a double promotion or re-run of
+        # an already-reported rung would show up as a duplicate here
+        job = coord2.store.get_job(sid, jid)
+        for stid, sub in job["subtasks"].items():
+            reports = Counter(
+                h.get("rung")
+                for h in sub.get("rung_history", [])
+                if h.get("report")
+            )
+            dup = {r: n for r, n in reports.items() if n > 1}
+            assert not dup, (stid, dup)
+        # all 9 trials terminal, none failed
+        assert len(jr2["results"]) + jr2["n_pruned"] == 9
+    finally:
+        cluster2.shutdown()
