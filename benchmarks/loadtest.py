@@ -1,0 +1,349 @@
+"""Control-plane load test: hundreds of concurrent clients vs ONE shard.
+
+ROADMAP item 2 (shard the control plane) needs a committed "before"
+artifact to beat: this harness drives N concurrent clients through the
+full REST surface of a single coordinator process — session create,
+train submit (with the admission-control 429/Retry-After contract
+honored), status polling, and an SSE subscriber fraction — and records
+per-operation p50/p99 latency plus end-to-end jobs-per-second.
+
+The jobs are deliberately tiny (iris LogisticRegression, 2 trials, cv=2):
+the point is to saturate the CONTROL plane (werkzeug request threads, the
+coordinator's locks, SSE delivery), not the device. The RED middleware's
+`tpuml_http_request_seconds{route,method,code}` histograms and the
+`tpuml_sse_lag_seconds` gauge are scraped from the same process at the
+end, so the committed JSON carries both the client-observed and the
+server-observed view of the same run.
+
+Writes benchmarks/loadtest_single_shard.json.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/loadtest.py
+Env: LOADTEST_CLIENTS=200 LOADTEST_JOBS_PER_CLIENT=2
+     LOADTEST_SSE_FRACTION=0.25 LOADTEST_EXECUTORS=2
+     LOADTEST_POLL_S=0.1 LOADTEST_RETRY_CAP_S=1.0
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CLIENTS = int(os.environ.get("LOADTEST_CLIENTS", 200))
+JOBS_PER_CLIENT = int(os.environ.get("LOADTEST_JOBS_PER_CLIENT", 2))
+SSE_FRACTION = float(os.environ.get("LOADTEST_SSE_FRACTION", 0.25))
+EXECUTORS = int(os.environ.get("LOADTEST_EXECUTORS", 2))
+POLL_S = float(os.environ.get("LOADTEST_POLL_S", 0.1))
+#: Retry-After is honored but capped — the server's 5 s default would
+#: turn a 30 s load test into minutes of idle backoff
+RETRY_CAP_S = float(os.environ.get("LOADTEST_RETRY_CAP_S", 1.0))
+TIMEOUT_S = float(os.environ.get("LOADTEST_TIMEOUT_S", 300.0))
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "loadtest_single_shard.json")
+
+
+def pctl(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (ceil(q*n)-th smallest); None on empty
+    input. int(q*n) would overstate by one rank whenever q*n is integral
+    — e.g. the p99 of exactly 100 samples must be the 99th smallest, not
+    the maximum."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    i = min(max(math.ceil(q * len(s)) - 1, 0), len(s) - 1)
+    return s[i]
+
+
+def lat_stats(xs: List[float]) -> Dict[str, Any]:
+    return {
+        "n": len(xs),
+        "p50_s": pctl(xs, 0.50),
+        "p99_s": pctl(xs, 0.99),
+        "mean_s": (sum(xs) / len(xs)) if xs else None,
+        "max_s": max(xs) if xs else None,
+    }
+
+
+class _Stats:
+    """Thread-shared latency/outcome accumulators."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.submit: List[float] = []
+        self.poll: List[float] = []
+        self.sse_first: List[float] = []
+        self.job_wall: List[float] = []
+        self.completed = 0
+        self.failed = 0
+        self.rejected_429 = 0
+        self.errors: List[str] = []
+
+    def add(self, field: str, value: float) -> None:
+        with self.lock:
+            getattr(self, field).append(value)
+
+    def bump(self, field: str, n: int = 1) -> None:
+        with self.lock:
+            setattr(self, field, getattr(self, field) + n)
+
+
+def _submit_with_retry(sess, url: str, sid: str, payload, stats: _Stats,
+                       deadline: float) -> Optional[str]:
+    """POST /train honoring the 429/Retry-After admission contract
+    (capped). Returns the job id, or None when the deadline passed."""
+    while time.time() < deadline:
+        t0 = time.perf_counter()
+        r = sess.post(f"{url}/train/{sid}", json=payload, timeout=60)
+        dt = time.perf_counter() - t0
+        if r.status_code == 429:
+            stats.bump("rejected_429")
+            retry = min(float(r.headers.get("Retry-After", 1.0)), RETRY_CAP_S)
+            time.sleep(retry)
+            continue
+        stats.add("submit", dt)
+        r.raise_for_status()
+        return r.json()["job_id"]
+    return None
+
+
+def _follow_sse(sess, url: str, sid: str, job_id: str, stats: _Stats) -> str:
+    """Resume-follow a submitted job over SSE (known job_id → never
+    rejected); records time-to-first-event. Returns the terminal status."""
+    t0 = time.perf_counter()
+    with sess.post(f"{url}/train_status/{sid}",
+                   json={"job_id": job_id}, stream=True, timeout=300) as r:
+        r.raise_for_status()
+        first = True
+        status = "unknown"
+        for line in r.iter_lines():
+            if not line or not line.startswith(b"data: "):
+                continue
+            if first:
+                stats.add("sse_first", time.perf_counter() - t0)
+                first = False
+            evt = json.loads(line[len(b"data: "):])
+            status = evt.get("job_status", status)
+            if evt.get("job_result") is not None or status in (
+                "completed", "failed", "completed_with_failures"
+            ):
+                return status
+    return status
+
+
+def _poll_status(sess, url: str, sid: str, job_id: str, stats: _Stats,
+                 deadline: float) -> str:
+    while time.time() < deadline:
+        t0 = time.perf_counter()
+        r = sess.get(f"{url}/check_status/{sid}/{job_id}", timeout=60)
+        stats.add("poll", time.perf_counter() - t0)
+        body = r.json()
+        status = body.get("job_status") or body.get("status")
+        if status in ("completed", "failed", "completed_with_failures"):
+            return status
+        time.sleep(POLL_S)
+    return "timeout"
+
+
+def _client_loop(i: int, url: str, payload, stats: _Stats,
+                 start_evt: threading.Event, deadline: float,
+                 jobs_per_client: int, use_sse: bool) -> None:
+    import requests
+
+    sess = requests.Session()
+    start_evt.wait()
+    try:
+        sid = sess.post(f"{url}/create_session", timeout=60).json()["session_id"]
+        for _ in range(jobs_per_client):
+            t0 = time.perf_counter()
+            job_id = _submit_with_retry(sess, url, sid, payload, stats, deadline)
+            if job_id is None:
+                stats.bump("failed")
+                continue
+            if use_sse:
+                status = _follow_sse(sess, url, sid, job_id, stats)
+            else:
+                status = _poll_status(sess, url, sid, job_id, stats, deadline)
+            stats.add("job_wall", time.perf_counter() - t0)
+            stats.bump("completed" if status == "completed" else "failed")
+    except Exception as e:  # noqa: BLE001 — one client's failure is data
+        with stats.lock:
+            stats.errors.append(f"client-{i}: {type(e).__name__}: {e}")
+        stats.bump("failed")
+
+
+def run(*, clients: int = CLIENTS, jobs_per_client: int = JOBS_PER_CLIENT,
+        sse_fraction: float = SSE_FRACTION,
+        executors: int = EXECUTORS) -> Dict[str, Any]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from sklearn.linear_model import LogisticRegression
+    from werkzeug.serving import make_server
+
+    from cs230_distributed_machine_learning_tpu.client.introspection import (
+        extract_model_details,
+    )
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        ClusterRuntime,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+
+    # one line per request x hundreds of clients x poll cadence would be
+    # most of the benchmark's wall time — silence the access log
+    import logging
+
+    logging.getLogger("werkzeug").setLevel(logging.ERROR)
+
+    materialize_builtin("iris")
+    cluster = ClusterRuntime()
+    for _ in range(max(executors, 1)):
+        cluster.add_executor()
+    coord = Coordinator(cluster=cluster)
+    server = make_server("127.0.0.1", 0, create_app(coord), threaded=True)
+    server_thread = threading.Thread(target=server.serve_forever, daemon=True)
+    server_thread.start()
+    url = f"http://127.0.0.1:{server.server_port}"
+
+    payload = {
+        "dataset_id": "iris",
+        "model_details": extract_model_details(
+            LogisticRegression(max_iter=50)
+        ),
+        "train_params": {
+            "test_size": 0.2, "random_state": 0, "cv": 2,
+            "search_type": "GridSearchCV",
+            "param_grid": {"C": [0.1, 1.0]},
+        },
+    }
+
+    # warm the executable/dataset caches so the measured window exercises
+    # the CONTROL plane, not one cold XLA compile
+    import requests
+
+    sid0 = requests.post(f"{url}/create_session", timeout=60).json()["session_id"]
+    warm = requests.post(f"{url}/train/{sid0}", json=payload, timeout=60).json()
+    deadline0 = time.time() + 120
+    while time.time() < deadline0:
+        st = requests.get(
+            f"{url}/check_status/{sid0}/{warm['job_id']}", timeout=60
+        ).json()
+        if st.get("job_status") in ("completed", "failed"):
+            break
+        time.sleep(0.2)
+
+    stats = _Stats()
+    start_evt = threading.Event()
+    deadline = time.time() + TIMEOUT_S
+    threads = [
+        threading.Thread(
+            target=_client_loop,
+            args=(i, url, payload, stats, start_evt, deadline,
+                  jobs_per_client, (i / max(clients, 1)) < sse_fraction),
+            daemon=True,
+        )
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start_evt.set()
+    for t in threads:
+        t.join(timeout=TIMEOUT_S)
+    wall = time.perf_counter() - t0
+
+    # server-observed view: refresh the derived route-p99 gauge (the same
+    # pooling /dashboard and the scrape use — one definition, obs/
+    # __init__.refresh_route_p99) and read its cells
+    from cs230_distributed_machine_learning_tpu.obs import refresh_route_p99
+
+    refresh_route_p99()
+    g = REGISTRY.gauge("tpuml_http_route_p99_seconds")
+    route_p99 = {
+        ls["route"]: round(g.value(**ls), 6) for ls in g.labelsets()
+    }
+    sse_lag = REGISTRY.gauge("tpuml_sse_lag_seconds").value()
+
+    server.shutdown()
+    cluster.shutdown()
+
+    n_jobs = stats.completed
+    out = {
+        "benchmark": "loadtest_single_shard",
+        "config": {
+            "clients": clients,
+            "jobs_per_client": jobs_per_client,
+            "sse_fraction": sse_fraction,
+            "executors": executors,
+            "poll_interval_s": POLL_S,
+            "job_shape": "iris LogisticRegression GridSearchCV 2 trials cv=2",
+            "admission_caps": {
+                "max_inflight_jobs": coord.config.service.max_inflight_jobs,
+                "max_inflight_jobs_per_session":
+                    coord.config.service.max_inflight_jobs_per_session,
+            },
+        },
+        "backend": _backend(),
+        "wall_s": round(wall, 3),
+        "jobs": {
+            "target": clients * jobs_per_client,
+            "completed": stats.completed,
+            "failed": stats.failed,
+            "rejected_429_retries": stats.rejected_429,
+        },
+        "jobs_per_second": round(n_jobs / wall, 3) if wall > 0 else None,
+        "latency_s": {
+            "submit": lat_stats(stats.submit),
+            "status_poll": lat_stats(stats.poll),
+            "sse_first_event": lat_stats(stats.sse_first),
+            "job_completion": lat_stats(stats.job_wall),
+        },
+        "server_observed": {
+            "route_p99_s": route_p99,
+            "sse_lag_s_last": sse_lag,
+        },
+        "errors": stats.errors[:20],
+        "note": (
+            "single-shard 'before' artifact for ROADMAP item 2: one "
+            "coordinator process, werkzeug threaded, tiny iris jobs so "
+            "the control plane (not the device) is the bottleneck. "
+            "Admission-control 429s are honored with capped Retry-After "
+            "and counted, not treated as failures. The sharding PR's "
+            "loadtest_4shard.json must beat jobs_per_second and the "
+            "submit/status p99s here at the same client count."
+        ),
+    }
+    return out
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+def main() -> None:
+    out = run()
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({
+        "jobs_per_second": out["jobs_per_second"],
+        "submit_p99_s": out["latency_s"]["submit"]["p99_s"],
+        "poll_p99_s": out["latency_s"]["status_poll"]["p99_s"],
+        "completed": out["jobs"]["completed"],
+        "failed": out["jobs"]["failed"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,17 +1,26 @@
 """Micro-benchmark: the CS230_OBS=0 disabled path must be near-free.
 
-Acceptance guard for the observability layer (ISSUE 2): with the valve
-off, an instrumented executor run must show no measurable regression vs.
-the same instrumented code — i.e. the per-call cost of the disabled
-helpers (one env read each) must vanish into run-to-run noise on a real
-tiny-job hot path.
+Acceptance guard for the observability layer (ISSUE 2, re-measured for
+the ISSUE 13 perf observatory): with the valve off, an instrumented
+executor run must show no measurable regression vs. the same
+instrumented code — i.e. the per-call cost of the disabled helpers (one
+env read each) must vanish into run-to-run noise on a real tiny-job hot
+path.
 
-Protocol: one warm-up + N timed ``LocalExecutor.run_subtasks`` calls on a
-small LogisticRegression batch (the dispatch-floor-bound shape, BASELINE
-config 1 spirit), alternating valve states to cancel drift; medians and
-spreads per state -> benchmarks/OBS_OVERHEAD_MICRO.json. The valve is
-read per call site, so flipping the env var mid-process is the real
-disabled path, not a proxy.
+Two sections, each alternating valve states to cancel drift (medians and
+spreads per state -> benchmarks/OBS_OVERHEAD_MICRO.json):
+
+- **executor**: N timed ``LocalExecutor.run_subtasks`` calls on a small
+  LogisticRegression batch (the dispatch-floor-bound shape, BASELINE
+  config 1 spirit). Since ISSUE 13 this path also feeds the device-time
+  attribution counter (obs/devprof.py) when enabled.
+- **http_middleware**: bursts of requests through the coordinator WSGI
+  app — the RED middleware's ``tpuml_http_request_seconds`` observation
+  plus the route counter are the per-request instrumentation cost under
+  test.
+
+The valve is read per call site, so flipping the env var mid-process is
+the real disabled path, not a proxy.
 
 Run: JAX_PLATFORMS=cpu python benchmarks/obs_overhead_micro.py
 """
@@ -26,6 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 N_PASSES = 9
 N_TRIALS = 8
+HTTP_REQS_PER_PASS = 300
 
 
 def main() -> None:
@@ -91,21 +101,79 @@ def main() -> None:
         if enabled["median_s"]
         else None
     )
+
+    # ---- http middleware section (ISSUE 13): request bursts through the
+    # coordinator WSGI app, same alternating protocol ----
+    from werkzeug.test import Client
+
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+
+    client = Client(create_app(Coordinator()))
+
+    def timed_http() -> float:
+        t0 = time.perf_counter()
+        for _ in range(HTTP_REQS_PER_PASS):
+            client.get("/health")
+        return time.perf_counter() - t0
+
+    os.environ["CS230_OBS"] = "1"
+    timed_http()  # warm
+    os.environ["CS230_OBS"] = "0"
+    timed_http()
+    http_samples = {"0": [], "1": []}
+    for i in range(2 * N_PASSES):
+        state = "0" if i % 2 == 0 else "1"
+        os.environ["CS230_OBS"] = state
+        http_samples[state].append(timed_http())
+    http_disabled = stats(http_samples["0"])
+    http_enabled = stats(http_samples["1"])
+    http_overhead = (
+        (http_disabled["median_s"] - http_enabled["median_s"])
+        / http_enabled["median_s"]
+        if http_enabled["median_s"]
+        else None
+    )
+
+    def verdict(dis, en, oh):
+        # one-sided contract: the DISABLED path must cost nothing — it may
+        # be faster than enabled (that surplus is the instrumentation's
+        # real price), never slower beyond noise
+        if oh is None:
+            return "see samples"
+        noise = max(dis["spread"] or 0, en["spread"] or 0)
+        if abs(oh) <= noise:
+            return "disabled path within noise of enabled"
+        if oh < 0:
+            return (
+                "disabled path strictly cheaper (the delta is the enabled "
+                "instrumentation's cost)"
+            )
+        return "DISABLED PATH REGRESSED — see samples"
+
     out = {
         "benchmark": "obs_overhead_micro",
         "config": {"n_trials": N_TRIALS, "cv": 3, "dataset": "iris",
-                   "model": "LogisticRegression", "passes_per_state": N_PASSES},
+                   "model": "LogisticRegression", "passes_per_state": N_PASSES,
+                   "http_reqs_per_pass": HTTP_REQS_PER_PASS},
         "backend": _backend(),
+        "instrumentation": (
+            "ISSUE 13 state: executor path feeds the per-phase device-"
+            "seconds counter (obs/devprof.py) and the server app runs the "
+            "RED request middleware — both under the same CS230_OBS valve"
+        ),
         "disabled_CS230_OBS_0": disabled,
         "enabled_CS230_OBS_1": enabled,
         "disabled_minus_enabled_relative": overhead,
-        "verdict": (
-            "disabled path within noise of enabled"
-            if overhead is not None and abs(overhead) <= max(
-                disabled["spread"] or 0, enabled["spread"] or 0
-            )
-            else "see samples"
-        ),
+        "verdict": verdict(disabled, enabled, overhead),
+        "http_middleware": {
+            "disabled_CS230_OBS_0": http_disabled,
+            "enabled_CS230_OBS_1": http_enabled,
+            "disabled_minus_enabled_relative": http_overhead,
+            "verdict": verdict(http_disabled, http_enabled, http_overhead),
+        },
     }
     path = os.path.join(os.path.dirname(__file__), "OBS_OVERHEAD_MICRO.json")
     with open(path, "w") as f:

@@ -37,9 +37,16 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from .devprof import (  # noqa: F401 — re-exported API
+    PROFILER,
+    DeviceProfiler,
+    device_seconds,
+    record_batch_device_seconds,
+)
 from .metrics import (  # noqa: F401 — re-exported API
     CALIBRATION_BUCKETS,
     DEFAULT_BUCKETS,
+    HTTP_BUCKETS,
     PLACEMENT_BUCKETS,
     Counter,
     Gauge,
@@ -112,6 +119,25 @@ def observe(
 
 def render_prometheus() -> str:
     return REGISTRY.render()
+
+
+def refresh_route_p99() -> None:
+    """Derive ``tpuml_http_route_p99_seconds{route=}`` from the request
+    histogram (methods and codes pooled per route). Called at scrape and
+    sweep time — the gauge exists so the embedded time-series ring can
+    sample a p99 without sampling histogram buckets (obs/timeseries.py
+    deliberately skips histograms)."""
+    if not obs_enabled():
+        return
+    h = REGISTRY.get("tpuml_http_request_seconds")
+    if not isinstance(h, Histogram):
+        return
+    routes = sorted({ls.get("route") for ls in h.labelsets() if ls.get("route")})
+    g = REGISTRY.gauge("tpuml_http_route_p99_seconds")
+    for route in routes:
+        p99 = h.quantile_where(0.99, route=route)
+        if p99 is not None:
+            g.set(p99, route=route)
 
 
 # ---------------- metric catalog ----------------
@@ -373,6 +399,35 @@ def register_catalog() -> None:
         "Lifecycle events recorded by the flight recorder, labeled by "
         "kind (placement, lease.reclaim, attempt, retry, quarantine, ...)",
     )
+    # ---- perf observatory (docs/OBSERVABILITY.md "Perf observatory") ----
+    c(
+        "tpuml_executor_device_seconds_total",
+        "Accumulated device/pipeline seconds per batch phase, labeled by "
+        "phase (stage|compile|dispatch|fetch) — executor-local batches "
+        "plus remote agents' batches at metrics ingest",
+    )
+    c(
+        "tpuml_profile_captures_total",
+        "Completed on-demand jax.profiler captures "
+        "(POST /profile/start|stop)",
+    )
+    h(
+        "tpuml_http_request_seconds",
+        "Control-plane request latency, labeled by route (endpoint name), "
+        "method, and code",
+        buckets=HTTP_BUCKETS,
+    )
+    g(
+        "tpuml_http_route_p99_seconds",
+        "Per-route p99 request latency, derived from "
+        "tpuml_http_request_seconds at scrape/sweep time so the embedded "
+        "time-series ring can sample it, labeled by route",
+    )
+    g(
+        "tpuml_sse_lag_seconds",
+        "Delivery lag of the most recent SSE progress event beyond the "
+        "stream's tick cadence (seconds a subscriber saw its event late)",
+    )
 
 
 register_catalog()
@@ -383,6 +438,7 @@ __all__ = [
     "gauge_set",
     "observe",
     "render_prometheus",
+    "refresh_route_p99",
     "register_catalog",
     "REGISTRY",
     "MetricsRegistry",
@@ -391,7 +447,12 @@ __all__ = [
     "Histogram",
     "DEFAULT_BUCKETS",
     "PLACEMENT_BUCKETS",
+    "HTTP_BUCKETS",
     "CALIBRATION_BUCKETS",
+    "PROFILER",
+    "DeviceProfiler",
+    "device_seconds",
+    "record_batch_device_seconds",
     "RECORDER",
     "FlightRecorder",
     "record_event",

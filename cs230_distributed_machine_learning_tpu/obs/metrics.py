@@ -44,6 +44,14 @@ PLACEMENT_BUCKETS: Tuple[float, ...] = (
     1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1, 0.5, 1.0,
 )
 
+#: control-plane request-latency buckets (seconds) — finer sub-ms low end
+#: than DEFAULT_BUCKETS (health polls and queue reads sit there), topping
+#: out at 30 s (an SSE stream's first byte under a slow job)
+HTTP_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
 #: dimensionless relative-error buckets for predictor calibration
 #: (|predicted - actual| / actual): 0.05 = within 5%, 10 = off by 10x —
 #: the range spans a well-calibrated predictor through a cold-started one
@@ -221,6 +229,59 @@ class Histogram:
         with self._lock:
             cell = self._cells.get(_label_key(labels))
             return cell[2] if cell else 0
+
+    def _interpolate(self, counts: List[int], n: int, q: float) -> float:
+        """Bucket-interpolated quantile (the standard Prometheus
+        ``histogram_quantile`` semantics, computed in-process): find the
+        bucket the q-th observation falls in and interpolate linearly
+        inside it. Observations above the top bound clamp to it (the
+        +Inf bucket has no interpolable width)."""
+        rank = min(max(float(q), 0.0), 1.0) * n
+        cum = 0
+        for i, cnt in enumerate(counts[: len(self.buckets)]):
+            prev = cum
+            cum += cnt
+            if cum >= rank and cnt > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                return lo + (hi - lo) * (rank - prev) / cnt
+        return float(self.buckets[-1])
+
+    def quantile(self, q: float, **labels: str) -> Optional[float]:
+        """Quantile estimate for one exact label set; None when empty."""
+        with self._lock:
+            cell = self._cells.get(_label_key(labels))
+            if cell is None or cell[2] == 0:
+                return None
+            counts, n = list(cell[0]), cell[2]
+        return self._interpolate(counts, n, q)
+
+    def quantile_where(self, q: float, **match: str) -> Optional[float]:
+        """Quantile over the MERGE of every cell whose labels include
+        ``match`` — e.g. ``quantile_where(0.99, route="health")`` pools
+        methods and status codes into one per-route estimate (the SLO
+        layer's route-p99 gauge refresh). None when nothing matches."""
+        want = set((str(k), str(v)) for k, v in match.items())
+        merged: Optional[List[int]] = None
+        n = 0
+        with self._lock:
+            for key, (counts, _s, c) in self._cells.items():
+                if not want <= set(key):
+                    continue
+                if merged is None:
+                    merged = list(counts)
+                else:
+                    merged = [a + b for a, b in zip(merged, counts)]
+                n += c
+        if merged is None or n == 0:
+            return None
+        return self._interpolate(merged, n, q)
+
+    def labelsets(self) -> List[Dict[str, str]]:
+        """Label sets with a live cell — the route-p99 refresh walks
+        these to know which routes have observations."""
+        with self._lock:
+            return [dict(key) for key in self._cells]
 
     def sum(self, **labels: str) -> float:
         with self._lock:

@@ -24,7 +24,14 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..obs import counter_inc, gauge_set, observe, process_token, record_event
+from ..obs import (
+    counter_inc,
+    gauge_set,
+    observe,
+    process_token,
+    record_batch_device_seconds,
+    record_event,
+)
 from ..utils.config import get_config
 from ..utils.logging import get_logger
 from .executor import DeviceLostError, LocalExecutor
@@ -379,6 +386,19 @@ class ClusterRuntime:
                 v = msg.get(field)
                 if isinstance(v, (int, float)):
                     observe(metric, float(v))
+            # device-time attribution for remote batches: the same phase
+            # totals feed tpuml_executor_device_seconds_total{phase=}, so
+            # one scrape attributes the whole fleet's device time
+            phase = {
+                f: msg.get(f)
+                for f in ("batch_compile_s", "batch_stage_s",
+                          "batch_dispatch_s", "batch_fetch_s")
+            }
+            if all(isinstance(v, (int, float)) for v in phase.values()):
+                record_batch_device_seconds(
+                    phase["batch_compile_s"], phase["batch_stage_s"],
+                    phase["batch_dispatch_s"], phase["batch_fetch_s"],
+                )
             algo = str(msg.get("algo") or "unknown")
             flops = msg.get("batch_model_flops")
             if flops is None:

@@ -27,6 +27,7 @@ from ..obs import (
     obs_enabled,
     observe,
     process_token,
+    record_batch_device_seconds,
     record_phase,
     span,
 )
@@ -444,6 +445,13 @@ class LocalExecutor:
             )
             return
         observe("tpuml_executor_dispatch_seconds", run.run_time_s)
+        # device-time attribution (obs/devprof.py): the same phase totals
+        # the synthesized trace children carry, accumulated into the
+        # tpuml_executor_device_seconds_total{phase=} counter
+        record_batch_device_seconds(
+            run.compile_time_s, run.stage_time_s,
+            run.run_time_s, run.fetch_time_s,
+        )
         resources = sampler.averages()
         batch_cost = self._record_batch_cost(
             run, model_type, dataset_id, len(idxs), resources

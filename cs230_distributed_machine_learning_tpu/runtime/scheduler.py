@@ -55,6 +55,7 @@ from ..obs import (
     obs_enabled,
     observe,
     record_event,
+    refresh_route_p99,
     span,
     timeseries_sample,
 )
@@ -848,7 +849,10 @@ class PlacementEngine:
             self.refresh_health_metrics()
         # one time-series sample per sweep: the embedded metrics history
         # rides the cadence every other periodic decision already runs on
-        # (obs/timeseries.py; throttled, no-op when disabled)
+        # (obs/timeseries.py; throttled, no-op when disabled). The derived
+        # route-p99 gauge refreshes first so the sample catches it even on
+        # coordinators nothing ever scrapes (dashboard-only deployments).
+        refresh_route_p99()
         timeseries_sample()
         return [w.worker_id for w in dead]
 

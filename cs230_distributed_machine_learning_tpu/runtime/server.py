@@ -18,6 +18,7 @@ import json
 from typing import Optional
 
 from ..obs import (
+    PROFILER,
     RECORDER,
     TIMESERIES,
     TRACE_HEADER,
@@ -26,6 +27,8 @@ from ..obs import (
     counter_inc,
     gauge_set,
     obs_enabled,
+    observe,
+    refresh_route_p99,
     render_prometheus,
     span,
     timeseries_sample,
@@ -60,6 +63,8 @@ _DASHBOARD_HTML = """<!doctype html>
 <div id="cost" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no cost data yet</div>
 <h2>Metrics history</h2>
 <div id="spark" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no samples yet</div>
+<h2>Perf observatory</h2>
+<div id="perfspark" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no samples yet</div>
 <h2>Flight recorder (latest events)</h2>
 <table id="events"><thead></thead><tbody></tbody></table>
 <h2>Workers</h2><table id="workers"><thead></thead><tbody></tbody></table>
@@ -159,6 +164,16 @@ const SPARKS = [
   {name: "tpuml_worker_breaker_state", title: "breaker state", mode: "raw"},
   {name: "tpuml_executor_mfu", title: "MFU", mode: "raw"},
 ];
+// perf-observatory panel (docs/OBSERVABILITY.md "Perf observatory"):
+// per-route p99 (the derived gauge the scrape refreshes) and the
+// device-seconds-per-phase RATE (fraction of wall the device pipeline
+// spends staging / compiling / dispatching / fetching)
+const PERF_SPARKS = [
+  {name: "tpuml_http_route_p99_seconds", title: "route p99 (s)", mode: "raw"},
+  {name: "tpuml_executor_device_seconds_total",
+   title: "device-s/s by phase", mode: "rate"},
+  {name: "tpuml_sse_lag_seconds", title: "SSE lag (s)", mode: "raw"},
+];
 function sparkSvg(pts){
   if (pts.length < 2) return "";
   const t0 = pts[0][0], t1 = pts[pts.length - 1][0];
@@ -175,8 +190,8 @@ function sparkSvg(pts){
 // counter samples -> per-interval rate (clamped at 0: restarts reset)
 const rate = s => s.slice(1).map((p, i) =>
   [p[0], Math.max(p[1] - s[i][1], 0) / Math.max(p[0] - s[i][0], 1e-9)]);
-async function renderSparks(el){
-  const blocks = await Promise.all(SPARKS.map(async p => {
+async function renderSparks(el, sparks){
+  const blocks = await Promise.all(sparks.map(async p => {
     const h = await get(`/metrics/history?name=${p.name}`);
     const series = ((h && h.series) || []).filter(s => s.samples.length > 1);
     if (!series.length) return "";
@@ -204,6 +219,10 @@ async function renderEvents(el, ev){
   listTable(el, rows);
 }
 async function tick(){
+  // fire-and-forget scrape: refreshes the derived gauges (route p99) and
+  // drives the time-series sampler even on direct-mode coordinators that
+  // have no sweep loop and no external Prometheus
+  fetch("/metrics/prom").catch(() => {});
   const [h, jobs, workers, queues, sup, ev] = await Promise.all(
     ["/health", "/jobs", "/workers", "/queues", "/supervisor",
      "/events?limit=500"].map(get));
@@ -222,7 +241,8 @@ async function tick(){
   kvTable(document.getElementById("queues"), queues);
   listTable(document.getElementById("sup"), sup);
   renderEvents(document.getElementById("events"), ev);
-  await renderSparks(document.getElementById("spark"));
+  await renderSparks(document.getElementById("spark"), SPARKS);
+  await renderSparks(document.getElementById("perfspark"), PERF_SPARKS);
   const latest = Array.isArray(jobs) && jobs.length ? jobs[0].job_id : null;
   renderTrace(document.getElementById("trace"),
               latest ? await get(`/trace/${latest}`) : null);
@@ -268,6 +288,12 @@ def create_app(coordinator: Optional[Coordinator] = None):
             # trees, the agents' span-shipping ingest, the per-job device
             # cost report, and the deep-health probe
             Rule("/metrics/prom", endpoint="metrics_prom", methods=["GET"]),
+            # on-demand deep profiling (docs/OBSERVABILITY.md "Perf
+            # observatory"): bracket a live workload with a programmatic
+            # jax.profiler capture dumped under <journal_dir>/profile/
+            Rule("/profile/start", endpoint="profile_start", methods=["POST"]),
+            Rule("/profile/stop", endpoint="profile_stop", methods=["POST"]),
+            Rule("/profile/status", endpoint="profile_status", methods=["GET"]),
             Rule("/trace/<jid>", endpoint="trace", methods=["GET"]),
             Rule("/trace_spans/<wid>", endpoint="trace_spans", methods=["POST"]),
             Rule("/cost/<jid>", endpoint="cost", methods=["GET"]),
@@ -340,6 +366,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /jobs",
                     "GET  /dashboard  (HTML)",
                     "GET  /metrics/prom  (Prometheus exposition)",
+                    "POST /profile/start  (on-demand jax.profiler capture)",
+                    "POST /profile/stop",
+                    "GET  /profile/status",
                     "GET  /metrics/history?name=&since=  (embedded time series)",
                     "GET  /trace/<job_id>  (span tree)",
                     "GET  /cost/<job_id>  (device cost report)",
@@ -426,7 +455,19 @@ def create_app(coordinator: Optional[Coordinator] = None):
         job_id = submit["job_id"]
 
         def stream():
+            # SSE-lag SLO signal: the stream's producer yields one event
+            # then sleeps one tick, so anything beyond the tick between
+            # consecutive yields is delivery lag — store-read time, GIL
+            # contention, and client/socket backpressure (the previous
+            # yield blocks until the subscriber drained it)
+            tick = coord.config.service.sse_tick_s
+            prev = _time.monotonic()
             for progress in coord.stream_status(sid, job_id):
+                now = _time.monotonic()
+                gauge_set(
+                    "tpuml_sse_lag_seconds", max(now - prev - tick, 0.0)
+                )
+                prev = now
                 yield f"data: {json.dumps(json_safe(progress))}\n\n"
 
         return Response(stream(), mimetype="text/event-stream")
@@ -457,6 +498,10 @@ def create_app(coordinator: Optional[Coordinator] = None):
         from .executor import record_hbm_gauges
 
         record_hbm_gauges()
+        # derived SLO gauges: per-route p99 from the request histogram —
+        # refreshed here so the time-series ring samples a p99 without
+        # sampling histogram buckets
+        refresh_route_p99()
         # each scrape also feeds the embedded time-series ring (throttled;
         # the sweep is the other driver) — direct-mode coordinators have
         # no sweep loop, so history still accumulates at scrape cadence
@@ -465,6 +510,37 @@ def create_app(coordinator: Optional[Coordinator] = None):
             render_prometheus(),
             content_type="text/plain; version=0.0.4; charset=utf-8",
         )
+
+    #: profiler error reasons -> HTTP status: disabled valve is 503 (come
+    #: back when obs is on), an open/absent capture is 409 (conflict with
+    #: the profiler's state), a backend/filesystem failure is a real 500
+    _PROFILE_STATUS = {"disabled": 503, "busy": 409, "idle": 409,
+                       "backend": 500}
+
+    def profile_start(request):
+        """Begin an on-demand jax.profiler capture (obs/devprof.py). Body
+        (optional JSON): ``{"tag": "..."}`` names the dump directory under
+        ``<journal_dir>/profile/``. 409 while a capture is already open,
+        503 when observability is disabled, 500 when the backend profiler
+        or the dump filesystem refuses."""
+        body = request.get_json(force=True, silent=True) or {}
+        out = PROFILER.start(body.get("tag"))
+        if out["status"] == "started":
+            return _json(out, status=201)
+        return _json(out, status=_PROFILE_STATUS.get(out.get("reason"), 500))
+
+    def profile_stop(request):
+        """Finish the active capture; returns the dump directory and file
+        count. 409 when no capture is open; 500 on a failed stop (the
+        capture stays active for a retry unless the backend reports the
+        session already gone)."""
+        out = PROFILER.stop()
+        if out["status"] == "stopped":
+            return _json(out, status=200)
+        return _json(out, status=_PROFILE_STATUS.get(out.get("reason"), 500))
+
+    def profile_status(request):
+        return _json(PROFILER.status())
 
     def cost(request, jid):
         """Per-job device cost report (docs/OBSERVABILITY.md): device-
@@ -819,6 +895,15 @@ def create_app(coordinator: Optional[Coordinator] = None):
         # echoed on the response. Untraced requests open no span at all
         # (a /health poll must not mint garbage traces).
         trace_id = request.headers.get(TRACE_HEADER)
+        # RED middleware (docs/OBSERVABILITY.md "Perf observatory"): every
+        # request lands in tpuml_http_request_seconds{route,method,code}.
+        # Routes label by ENDPOINT name (bounded cardinality — path params
+        # never become label values); unmatched paths pool under one
+        # "unmatched" cell. Streaming (SSE) responses record time to the
+        # response object — the submit latency; delivery lag has its own
+        # gauge (tpuml_sse_lag_seconds).
+        t0 = _time.perf_counter()
+        endpoint = None
         try:
             endpoint, values = url_map.bind_to_environ(request.environ).match()
             counter_inc("tpuml_http_requests_total", endpoint=endpoint)
@@ -838,6 +923,13 @@ def create_app(coordinator: Optional[Coordinator] = None):
             resp = _json({"status": "error", "message": str(e)}, status=404)
         except Exception as e:  # noqa: BLE001
             resp = _json({"status": "error", "message": str(e)}, status=500)
+        observe(
+            "tpuml_http_request_seconds",
+            _time.perf_counter() - t0,
+            route=endpoint or "unmatched",
+            method=request.method,
+            code=str(resp.status_code),
+        )
         resp.headers.extend(_cors)
         if trace_id:
             resp.headers[TRACE_HEADER] = trace_id

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search]   (default: fast)
+#   ./ci.sh [fast|kernels|chaos|search|perf]   (default: fast)
 #
 #   fast mode:
 #   1. compileall lint gate — every .py in the package, tests, and
@@ -30,6 +30,15 @@
 #   (ASHA vs exhaustive RandomizedSearch on the covertype config; gate:
 #   score parity ±1e-3 AND <= 0.5x device-seconds) which refreshes
 #   benchmarks/ADAPTIVE_SEARCH.json into bench-artifacts/.
+#
+#   perf mode (manually-triggered + nightly in ci.yml, like chaos): the
+#   valve A/B regression harness (benchmarks/perf_observatory.py) in
+#   quick mode with the noise-aware gate against the committed
+#   benchmarks/PERF_OBSERVATORY.json baselines — a perf valve silently
+#   regressing (legacy fallback, lost cache keying) fails the job —
+#   followed by an injected-regression drill (PERF_OBS_INJECT) proving
+#   the gate itself still trips. Fresh measurements always land in
+#   bench-artifacts/PERF_OBSERVATORY.json for upload.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -95,6 +104,33 @@ elif [ "$MODE" = "search" ]; then
   else
     echo "adaptive_search FAILED (see bench-artifacts/adaptive_search.log)"
     rc=1
+  fi
+elif [ "$MODE" = "perf" ]; then
+  echo "== perf observatory: valve A/B + noise-aware gate (quick) =="
+  mkdir -p bench-artifacts
+  # measure fresh (quick: fewer reps, identical shapes) and gate against
+  # the committed baseline; the measurement document is uploaded either way
+  if ! JAX_PLATFORMS=cpu python benchmarks/perf_observatory.py \
+      --quick --check \
+      --out bench-artifacts/PERF_OBSERVATORY.json \
+      --baseline benchmarks/PERF_OBSERVATORY.json \
+      2>&1 | tee bench-artifacts/perf_observatory.log; then
+    echo "perf gate RED (see bench-artifacts/perf_observatory.log)"
+    rc=1
+  fi
+  # all.on (not all): scaling only the fast-path states also shifts the
+  # on/off delta, so the drill trips the comparator's cross-host delta
+  # mode too — a uniform all= slowdown is, by design, invisible there
+  echo "== injected-regression drill: the gate must trip on a synthetic 10x =="
+  if PERF_OBS_INJECT="all.on=10.0" JAX_PLATFORMS=cpu \
+      python benchmarks/perf_observatory.py \
+      --compare-only bench-artifacts/PERF_OBSERVATORY.json \
+      --baseline benchmarks/PERF_OBSERVATORY.json \
+      > bench-artifacts/perf_inject_drill.log 2>&1; then
+    echo "DRILL FAILED: injected regression was NOT caught"
+    rc=1
+  else
+    echo "drill ok: injected regression tripped the gate"
   fi
 elif [ "$MODE" = "chaos" ]; then
   echo "== chaos/durability suite (JAX_PLATFORMS=cpu, -m slow) =="

@@ -1,0 +1,367 @@
+"""Perf observatory (docs/OBSERVABILITY.md "Perf observatory"): the
+noise-aware A/B comparator, device-time attribution (obs/devprof.py), the
+RED request middleware's route/method/code labeling over a live socket,
+the derived route-p99 gauge, the SSE-lag gauge, and the /profile
+start/stop round trip landing a real trace artifact in the journal dir."""
+
+import importlib.util
+import os
+import threading
+import time
+
+import pytest
+import requests
+
+from cs230_distributed_machine_learning_tpu.obs import REGISTRY, Histogram
+from cs230_distributed_machine_learning_tpu.obs.devprof import (
+    PROFILER,
+    device_seconds,
+    phase_totals,
+    record_batch_device_seconds,
+)
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+
+
+def _load_perf_observatory():
+    path = os.path.join(
+        os.path.dirname(__file__), "..", "benchmarks", "perf_observatory.py"
+    )
+    spec = importlib.util.spec_from_file_location("perf_observatory", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+po = _load_perf_observatory()
+
+
+def _doc(backend="cpu", **components):
+    return {"benchmark": "perf_observatory", "backend": backend,
+            "components": components}
+
+
+def _state(median, spread=0.1):
+    return {"median_s": median, "min_s": median, "spread": spread}
+
+
+# ---------------- comparator ----------------
+
+
+def test_comparator_flags_regression_beyond_spread():
+    base = _doc(x={"on": _state(1.0, 0.1), "off": _state(2.0, 0.1)})
+    cur = _doc(x={"on": _state(1.8, 0.1), "off": _state(2.05, 0.1)})
+    regs, checked, skipped = po.compare_to_baseline(
+        cur, base, noise_floor=0.25
+    )
+    assert len(checked) == 2 and not skipped
+    assert [r["state"] for r in regs] == ["on"]  # 1.8x > 1+0.25; off within
+    assert regs[0]["ratio"] == pytest.approx(1.8)
+    assert regs[0]["tolerance"] == pytest.approx(0.25)
+
+
+def test_comparator_within_spread_noise_passes():
+    base = _doc(x={"on": _state(1.0, 0.3), "off": _state(1.0, 0.05)})
+    # +28% but the BASELINE recorded 30% spread: noise, not regression
+    cur = _doc(x={"on": _state(1.28, 0.05), "off": _state(1.1, 0.05)})
+    regs, checked, _ = po.compare_to_baseline(cur, base, noise_floor=0.15)
+    assert not regs and len(checked) == 2
+
+
+def test_comparator_missing_baseline_is_skip_not_crash():
+    cur = _doc(x={"on": _state(1.0), "off": _state(1.0)})
+    # no baseline document at all
+    regs, checked, skipped = po.compare_to_baseline(cur, None)
+    assert regs == [] and checked == [] and len(skipped) == 1
+    # baseline exists but lacks the component
+    regs, checked, skipped = po.compare_to_baseline(
+        cur, _doc(y={"on": _state(1.0), "off": _state(1.0)})
+    )
+    assert regs == [] and checked == []
+    assert skipped[0]["component"] == "x"
+    # a state missing on either side skips that state only
+    regs, checked, skipped = po.compare_to_baseline(
+        cur, _doc(x={"on": _state(1.0)})
+    )
+    assert [c["state"] for c in checked] == ["on"]
+    assert any("off" in s["component"] for s in skipped)
+
+
+def test_comparator_cross_host_gates_delta_not_absolute():
+    """Across different host fingerprints absolute wall clocks are not
+    comparable: the gate must fall back to the within-run on/off delta —
+    a silent fast-path fallback (on collapsing toward off, delta
+    worsening) trips it, while a uniformly slower machine does not."""
+    base = _doc(x={"on": _state(1.0, 0.05), "off": _state(2.0, 0.05),
+                   "delta_on_vs_off_pct": -50.0})
+    base["host"] = {"machine": "x86_64", "cpus": 24}
+    # a 3x slower machine, healthy valve: same delta -> no regression
+    cur = _doc(x={"on": _state(3.0, 0.05), "off": _state(6.0, 0.05),
+                  "delta_on_vs_off_pct": -50.0})
+    cur["host"] = {"machine": "x86_64", "cpus": 4}
+    regs, checked, _ = po.compare_to_baseline(cur, base, noise_floor=0.25)
+    assert not regs
+    assert checked and checked[0]["mode"] == "cross-host"
+    # silent fallback: on == off on the new machine (delta -50 -> 0,
+    # worsening by 50 points > the 25-point tolerance)
+    cur2 = _doc(x={"on": _state(6.0, 0.05), "off": _state(6.0, 0.05),
+                   "delta_on_vs_off_pct": 0.0})
+    cur2["host"] = {"machine": "x86_64", "cpus": 4}
+    regs, _, _ = po.compare_to_baseline(cur2, base, noise_floor=0.25)
+    assert len(regs) == 1 and regs[0]["state"] == "delta_on_vs_off"
+    # matching fingerprints keep the absolute-median gate
+    cur3 = _doc(x={"on": _state(1.0, 0.05), "off": _state(2.0, 0.05)})
+    cur3["host"] = dict(base["host"])
+    _, checked3, _ = po.compare_to_baseline(cur3, base, noise_floor=0.25)
+    assert {c["state"] for c in checked3} == {"on", "off"}
+
+
+def test_comparator_backend_mismatch_skips_everything():
+    base = _doc(backend="tpu", x={"on": _state(0.01), "off": _state(0.01)})
+    cur = _doc(backend="cpu", x={"on": _state(1.0), "off": _state(1.0)})
+    regs, checked, skipped = po.compare_to_baseline(cur, base)
+    assert not regs and not checked
+    assert "backend mismatch" in skipped[0]["reason"]
+
+
+def test_injection_trips_the_gate():
+    base = _doc(x={"on": _state(1.0, 0.1), "off": _state(1.0, 0.1)})
+    cur = _doc(x={"on": _state(1.0, 0.1), "off": _state(1.0, 0.1)})
+    regs, _, _ = po.compare_to_baseline(cur, base)
+    assert not regs
+    injected = po.apply_injection(cur, "all=10.0")
+    regs, _, _ = po.compare_to_baseline(injected, base)
+    assert len(regs) == 2  # both states 10x
+    # targeted injection hits one state; the original doc is untouched
+    injected = po.apply_injection(cur, "x.on=5.0")
+    regs, _, _ = po.compare_to_baseline(injected, base)
+    assert [r["state"] for r in regs] == ["on"]
+    assert cur["components"]["x"]["on"]["median_s"] == 1.0
+    # malformed entries are ignored, not fatal
+    assert po.apply_injection(cur, "nope,alsobad=,x=abc") is not None
+    # all.on scales one state fleet-wide AND recomputes the delta, so the
+    # CI drill also trips the comparator's cross-host delta mode
+    shifted = po.apply_injection(cur, "all.on=10.0")
+    assert shifted["components"]["x"]["on"]["median_s"] == 10.0
+    assert shifted["components"]["x"]["off"]["median_s"] == 1.0
+    assert shifted["components"]["x"]["delta_on_vs_off_pct"] == 900.0
+    base_x = _doc(x={"on": _state(1.0, 0.1), "off": _state(1.0, 0.1),
+                     "delta_on_vs_off_pct": 0.0})
+    base_x["host"] = {"machine": "x86_64", "cpus": 24}
+    shifted["host"] = {"machine": "x86_64", "cpus": 4}
+    regs, _, _ = po.compare_to_baseline(shifted, base_x)
+    assert regs and regs[0]["state"] == "delta_on_vs_off"
+
+
+# ---------------- histogram quantiles ----------------
+
+
+def test_histogram_quantile_and_merge():
+    h = Histogram("q_demo_seconds", buckets=(0.1, 1.0, 10.0))
+    assert h.quantile(0.99) is None
+    for _ in range(9):
+        h.observe(0.05, route="r", method="GET")
+    h.observe(5.0, route="r", method="POST")
+    # exact-cell quantile: all GET observations in the first bucket
+    assert h.quantile(0.99, route="r", method="GET") <= 0.1
+    # merged per-route: 1-in-10 slow POSTs put the pooled p99 (rank 9.9
+    # of 10) inside the slow bucket, above 1.0
+    merged = h.quantile_where(0.99, route="r")
+    assert merged is not None and merged > 1.0
+    assert h.quantile_where(0.99, route="nope") is None
+
+
+# ---------------- device-time attribution ----------------
+
+
+def test_device_seconds_accumulates_per_phase():
+    before = phase_totals()
+    record_batch_device_seconds(
+        compile_s=0.5, stage_s=0.25, run_s=1.0, fetch_s=0.25
+    )
+    after = phase_totals()
+    assert after["compile"] - before["compile"] == pytest.approx(0.5)
+    assert after["stage"] - before["stage"] == pytest.approx(0.25)
+    # dispatch = run minus the fetches inside it
+    assert after["dispatch"] - before["dispatch"] == pytest.approx(0.75)
+    assert after["fetch"] - before["fetch"] == pytest.approx(0.25)
+    # negative dispatch clamps instead of decrementing the counter
+    record_batch_device_seconds(0.0, 0.0, run_s=0.1, fetch_s=0.5)
+    assert phase_totals()["dispatch"] == pytest.approx(after["dispatch"])
+
+
+def test_device_seconds_valve_off_is_noop(monkeypatch):
+    before = phase_totals()
+    monkeypatch.setenv("CS230_OBS", "0")
+    device_seconds("dispatch", 123.0)
+    record_batch_device_seconds(1.0, 1.0, 1.0, 0.0)
+    monkeypatch.setenv("CS230_OBS", "1")
+    assert phase_totals() == before
+
+
+def test_executor_feeds_device_seconds():
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    materialize_builtin("iris")
+    before = phase_totals()
+    subtasks = create_subtasks(
+        "devsec-j", "sess", "iris",
+        {"model_type": "LogisticRegression", "search_type": "GridSearchCV",
+         "base_estimator_params": {"max_iter": 50},
+         "param_grid": {"C": [0.5, 1.0]}},
+        {"test_size": 0.2, "random_state": 0, "cv": 3},
+    )
+    results = LocalExecutor().run_subtasks(subtasks)
+    assert all(r["status"] == "completed" for r in results)
+    after = phase_totals()
+    assert after["dispatch"] > before["dispatch"]
+
+
+# ---------------- live server: RED middleware + profile + p99 ----------------
+
+
+@pytest.fixture()
+def live_server():
+    from werkzeug.serving import make_server
+
+    coord = Coordinator()
+    server = make_server("127.0.0.1", 0, create_app(coord), threaded=True)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{server.server_port}"
+    server.shutdown()
+
+
+def test_red_middleware_labels_route_method_code(live_server):
+    h = REGISTRY.get("tpuml_http_request_seconds")
+    base_ok = h.count(route="health", method="GET", code="200")
+    base_404 = h.count(route="unmatched", method="GET", code="404")
+    base_201 = h.count(route="create_session", method="POST", code="201")
+    for _ in range(3):
+        assert requests.get(f"{live_server}/health", timeout=10).ok
+    assert requests.get(f"{live_server}/no-such-path", timeout=10).status_code == 404
+    assert requests.post(f"{live_server}/create_session", timeout=10).status_code == 201
+    assert h.count(route="health", method="GET", code="200") == base_ok + 3
+    assert h.count(route="unmatched", method="GET", code="404") == base_404 + 1
+    assert h.count(route="create_session", method="POST", code="201") == base_201 + 1
+    # the scrape exposes the histogram and refreshes the derived p99 gauge
+    prom = requests.get(f"{live_server}/metrics/prom", timeout=10).text
+    assert "tpuml_http_request_seconds_bucket" in prom
+    g = REGISTRY.gauge("tpuml_http_route_p99_seconds")
+    assert g.value(route="health") > 0
+
+
+def test_red_middleware_valve_off_records_nothing(live_server, monkeypatch):
+    h = REGISTRY.get("tpuml_http_request_seconds")
+    base = h.count(route="health", method="GET", code="200")
+    monkeypatch.setenv("CS230_OBS", "0")
+    assert requests.get(f"{live_server}/health", timeout=10).ok
+    monkeypatch.setenv("CS230_OBS", "1")
+    assert h.count(route="health", method="GET", code="200") == base
+
+
+def test_profile_round_trip_lands_artifact_in_journal_dir(
+    live_server, tmp_path, monkeypatch
+):
+    journal = tmp_path / "journal"
+    monkeypatch.setenv("CS230_JOURNAL_DIR", str(journal))
+    r = requests.post(f"{live_server}/profile/start",
+                      json={"tag": "roundtrip"}, timeout=10)
+    assert r.status_code == 201, r.text
+    trace_dir = r.json()["trace_dir"]
+    assert trace_dir.startswith(str(journal))
+    try:
+        # a second start while capturing is refused, not crashed
+        assert requests.post(f"{live_server}/profile/start",
+                             timeout=10).status_code == 409
+        assert requests.get(f"{live_server}/profile/status",
+                            timeout=10).json()["active"] is True
+        # some device work inside the capture window
+        import jax.numpy as jnp
+
+        (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+    finally:
+        r2 = requests.post(f"{live_server}/profile/stop", timeout=10)
+    assert r2.status_code == 200, r2.text
+    body = r2.json()
+    assert body["status"] == "stopped" and body["n_files"] > 0
+    # the artifact really landed under the journal dir
+    files = [os.path.join(dp, f)
+             for dp, _, fs in os.walk(trace_dir) for f in fs]
+    assert files, "no trace artifact written"
+    # stop with no capture active is a 409
+    assert requests.post(f"{live_server}/profile/stop",
+                         timeout=10).status_code == 409
+
+
+def test_profile_events_recorded():
+    from cs230_distributed_machine_learning_tpu.obs import RECORDER
+
+    seq0 = RECORDER.last_seq()
+    out = PROFILER.start("evt-test")
+    assert out["status"] == "started"
+    out = PROFILER.stop()
+    assert out["status"] == "stopped"
+    events, _ = RECORDER.events(since=seq0)
+    kinds = [e["kind"] for e in events]
+    assert "profile.start" in kinds and "profile.stop" in kinds
+
+
+def test_profile_start_disabled_valve_is_503(live_server, monkeypatch):
+    monkeypatch.setenv("CS230_OBS", "0")
+    r = requests.post(f"{live_server}/profile/start", timeout=10)
+    monkeypatch.setenv("CS230_OBS", "1")
+    assert r.status_code == 503
+
+
+def test_profile_tag_cannot_traverse_paths():
+    from cs230_distributed_machine_learning_tpu.obs.devprof import _sanitize_tag
+
+    assert "/" not in (_sanitize_tag("../../etc/passwd") or "")
+    assert _sanitize_tag("ok-tag_1.2") == "ok-tag_1.2"
+    assert _sanitize_tag(None) is None
+
+
+# ---------------- SSE lag gauge ----------------
+
+
+def test_sse_lag_gauge_written_by_stream(monkeypatch):
+    from werkzeug.test import Client
+
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+    materialize_builtin("iris")
+    get_config().service.sse_tick_s = 0.05
+    g = REGISTRY.gauge("tpuml_sse_lag_seconds")
+    g.remove()  # clear any cell from earlier tests
+    client = Client(create_app(Coordinator()))
+    sid = client.post("/create_session").get_json()["session_id"]
+    from sklearn.linear_model import LogisticRegression
+
+    from cs230_distributed_machine_learning_tpu.client.introspection import (
+        extract_model_details,
+    )
+
+    resp = client.post(f"/train_status/{sid}", json={
+        "dataset_id": "iris",
+        "model_details": extract_model_details(LogisticRegression(max_iter=50)),
+        "train_params": {"test_size": 0.2, "random_state": 0, "cv": 2,
+                         "search_type": "GridSearchCV",
+                         "param_grid": {"C": [1.0]}},
+    })
+    assert resp.status_code == 200
+    assert b"job_status" in resp.get_data()  # the stream ran to completion
+    # the gauge has a live cell now (lag >= 0 — tiny on an idle box)
+    assert g.labelsets() == [{}]
+    assert g.value() >= 0.0
