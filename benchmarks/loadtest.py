@@ -1,26 +1,38 @@
-"""Control-plane load test: hundreds of concurrent clients vs ONE shard.
+"""Control-plane load test: hundreds of concurrent clients vs the fleet.
 
-ROADMAP item 2 (shard the control plane) needs a committed "before"
-artifact to beat: this harness drives N concurrent clients through the
-full REST surface of a single coordinator process — session create,
-train submit (with the admission-control 429/Retry-After contract
-honored), status polling, and an SSE subscriber fraction — and records
-per-operation p50/p99 latency plus end-to-end jobs-per-second.
+Two modes, SAME client loops, latency accounting, and job shape — so the
+committed artifacts are directly comparable:
+
+- **single-shard** (``LOADTEST_SHARDS=0``, the default): one in-process
+  coordinator, the ROADMAP item 2 "before" artifact
+  (benchmarks/loadtest_single_shard.json: 8.1 jobs/s, submit p99 0.9 s).
+- **sharded** (``LOADTEST_SHARDS=N``): N coordinator-shard SUBPROCESSES
+  (own interpreter/GIL each) behind ``LOADTEST_FRONTENDS`` stateless
+  front-end subprocesses (runtime/frontend.py), launched via
+  runtime/fleet.ShardFleet. Clients spread round-robin over the front
+  ends; every request crosses the proxy hop, so the numbers charge the
+  front/core split honestly. Writes benchmarks/loadtest_<N>shard.json —
+  the acceptance artifact must beat single-shard jobs/s AND submit p99
+  AND poll p99 at equal-or-higher client count.
 
 The jobs are deliberately tiny (iris LogisticRegression, 2 trials, cv=2):
 the point is to saturate the CONTROL plane (werkzeug request threads, the
 coordinator's locks, SSE delivery), not the device. The RED middleware's
 `tpuml_http_request_seconds{route,method,code}` histograms and the
-`tpuml_sse_lag_seconds` gauge are scraped from the same process at the
-end, so the committed JSON carries both the client-observed and the
-server-observed view of the same run.
+`tpuml_sse_lag_seconds` gauge are scraped at the end (per shard in
+sharded mode), so the committed JSON carries both the client-observed and
+the server-observed view of the same run.
 
-Writes benchmarks/loadtest_single_shard.json.
+``--smoke`` asserts functional health instead of speed (every job
+completed, every shard actually received jobs, job ids carry routable
+stamps) and exits non-zero on violation — the CI sharded smoke
+(deploy/ci.sh), with no absolute-latency gate.
 
 Run: JAX_PLATFORMS=cpu python benchmarks/loadtest.py
 Env: LOADTEST_CLIENTS=200 LOADTEST_JOBS_PER_CLIENT=2
      LOADTEST_SSE_FRACTION=0.25 LOADTEST_EXECUTORS=2
      LOADTEST_POLL_S=0.1 LOADTEST_RETRY_CAP_S=1.0
+     LOADTEST_SHARDS=4 LOADTEST_FRONTENDS=2 LOADTEST_OUT=...
 """
 
 from __future__ import annotations
@@ -38,14 +50,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 CLIENTS = int(os.environ.get("LOADTEST_CLIENTS", 200))
 JOBS_PER_CLIENT = int(os.environ.get("LOADTEST_JOBS_PER_CLIENT", 2))
 SSE_FRACTION = float(os.environ.get("LOADTEST_SSE_FRACTION", 0.25))
+#: executors per shard (and total, in single-shard mode)
 EXECUTORS = int(os.environ.get("LOADTEST_EXECUTORS", 2))
 POLL_S = float(os.environ.get("LOADTEST_POLL_S", 0.1))
 #: Retry-After is honored but capped — the server's 5 s default would
 #: turn a 30 s load test into minutes of idle backoff
 RETRY_CAP_S = float(os.environ.get("LOADTEST_RETRY_CAP_S", 1.0))
 TIMEOUT_S = float(os.environ.get("LOADTEST_TIMEOUT_S", 300.0))
-OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "loadtest_single_shard.json")
+#: 0 = the in-process single-shard mode; N >= 2 = N shard subprocesses
+SHARDS = int(os.environ.get("LOADTEST_SHARDS", 0))
+FRONTENDS = int(os.environ.get("LOADTEST_FRONTENDS", 2))
+_BENCH_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _out_path(shards: int) -> str:
+    name = (
+        "loadtest_single_shard.json" if shards <= 0
+        else f"loadtest_{shards}shard.json"
+    )
+    return os.environ.get("LOADTEST_OUT") or os.path.join(_BENCH_DIR, name)
 
 
 def pctl(xs: List[float], q: float) -> Optional[float]:
@@ -121,7 +144,13 @@ def _follow_sse(sess, url: str, sid: str, job_id: str, stats: _Stats) -> str:
         r.raise_for_status()
         first = True
         status = "unknown"
-        for line in r.iter_lines():
+        # chunk_size=1: http.client's chunked read(amt) blocks until ~amt
+        # bytes accumulate, which would charge the server for CLIENT-side
+        # buffering (the pre-fix sse_first_event p50 of 4.9 s was ~3 ticks
+        # of events backing up behind a 512-byte read); byte reads measure
+        # true server time-to-first-event (the server also pads, so
+        # default-buffered clients get the first event immediately too)
+        for line in r.iter_lines(chunk_size=1):
             if not line or not line.startswith(b"data: "):
                 continue
             if first:
@@ -177,16 +206,76 @@ def _client_loop(i: int, url: str, payload, stats: _Stats,
         stats.bump("failed")
 
 
-def run(*, clients: int = CLIENTS, jobs_per_client: int = JOBS_PER_CLIENT,
-        sse_fraction: float = SSE_FRACTION,
-        executors: int = EXECUTORS) -> Dict[str, Any]:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+def _make_payload() -> Dict[str, Any]:
     from sklearn.linear_model import LogisticRegression
-    from werkzeug.serving import make_server
 
     from cs230_distributed_machine_learning_tpu.client.introspection import (
         extract_model_details,
     )
+
+    return {
+        "dataset_id": "iris",
+        "model_details": extract_model_details(
+            LogisticRegression(max_iter=50)
+        ),
+        "train_params": {
+            "test_size": 0.2, "random_state": 0, "cv": 2,
+            "search_type": "GridSearchCV",
+            "param_grid": {"C": [0.1, 1.0]},
+        },
+    }
+
+
+def _warm_job(url: str, sid: str, payload, timeout_s: float = 120.0) -> None:
+    """Submit one job and wait it out — executable/dataset cache warming
+    so the measured window exercises the CONTROL plane, not cold XLA."""
+    import requests
+
+    warm = requests.post(f"{url}/train/{sid}", json=payload, timeout=60).json()
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        st = requests.get(
+            f"{url}/check_status/{sid}/{warm['job_id']}", timeout=60
+        ).json()
+        if st.get("job_status") in ("completed", "failed"):
+            return
+        time.sleep(0.2)
+
+
+def _drive(urls: List[str], payload, *, clients: int, jobs_per_client: int,
+           sse_fraction: float):
+    """The measured window: `clients` threads spread round-robin over
+    `urls` (one entry in single-shard mode; the front ends in sharded
+    mode), each running the submit/poll-or-SSE loop. Returns
+    (stats, wall_s)."""
+    stats = _Stats()
+    start_evt = threading.Event()
+    deadline = time.time() + TIMEOUT_S
+    threads = [
+        threading.Thread(
+            target=_client_loop,
+            args=(i, urls[i % len(urls)], payload, stats, start_evt,
+                  deadline, jobs_per_client,
+                  (i / max(clients, 1)) < sse_fraction),
+            daemon=True,
+        )
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    t0 = time.perf_counter()
+    start_evt.set()
+    for t in threads:
+        t.join(timeout=TIMEOUT_S)
+    return stats, time.perf_counter() - t0
+
+
+def run(*, clients: int = CLIENTS, jobs_per_client: int = JOBS_PER_CLIENT,
+        sse_fraction: float = SSE_FRACTION,
+        executors: int = EXECUTORS) -> Dict[str, Any]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from werkzeug.serving import make_server
+
     from cs230_distributed_machine_learning_tpu.data.datasets import (
         materialize_builtin,
     )
@@ -215,52 +304,17 @@ def run(*, clients: int = CLIENTS, jobs_per_client: int = JOBS_PER_CLIENT,
     server_thread.start()
     url = f"http://127.0.0.1:{server.server_port}"
 
-    payload = {
-        "dataset_id": "iris",
-        "model_details": extract_model_details(
-            LogisticRegression(max_iter=50)
-        ),
-        "train_params": {
-            "test_size": 0.2, "random_state": 0, "cv": 2,
-            "search_type": "GridSearchCV",
-            "param_grid": {"C": [0.1, 1.0]},
-        },
-    }
+    payload = _make_payload()
 
-    # warm the executable/dataset caches so the measured window exercises
-    # the CONTROL plane, not one cold XLA compile
     import requests
 
     sid0 = requests.post(f"{url}/create_session", timeout=60).json()["session_id"]
-    warm = requests.post(f"{url}/train/{sid0}", json=payload, timeout=60).json()
-    deadline0 = time.time() + 120
-    while time.time() < deadline0:
-        st = requests.get(
-            f"{url}/check_status/{sid0}/{warm['job_id']}", timeout=60
-        ).json()
-        if st.get("job_status") in ("completed", "failed"):
-            break
-        time.sleep(0.2)
+    _warm_job(url, sid0, payload)
 
-    stats = _Stats()
-    start_evt = threading.Event()
-    deadline = time.time() + TIMEOUT_S
-    threads = [
-        threading.Thread(
-            target=_client_loop,
-            args=(i, url, payload, stats, start_evt, deadline,
-                  jobs_per_client, (i / max(clients, 1)) < sse_fraction),
-            daemon=True,
-        )
-        for i in range(clients)
-    ]
-    for t in threads:
-        t.start()
-    t0 = time.perf_counter()
-    start_evt.set()
-    for t in threads:
-        t.join(timeout=TIMEOUT_S)
-    wall = time.perf_counter() - t0
+    stats, wall = _drive(
+        [url], payload, clients=clients, jobs_per_client=jobs_per_client,
+        sse_fraction=sse_fraction,
+    )
 
     # server-observed view: refresh the derived route-p99 gauge (the same
     # pooling /dashboard and the scrape use — one definition, obs/
@@ -326,6 +380,184 @@ def run(*, clients: int = CLIENTS, jobs_per_client: int = JOBS_PER_CLIENT,
     return out
 
 
+_ROUTE_P99_RE = r'^tpuml_http_route_p99_seconds\{route="([^"]+)"\} ([0-9eE.+-]+)'
+_SSE_LAG_RE = r"^tpuml_sse_lag_seconds ([0-9eE.+-]+)"
+
+
+def _scrape_shard(url: str) -> Dict[str, Any]:
+    """Server-observed SLOs off one shard's /metrics/prom text: the
+    derived per-route p99 gauge (refreshed at scrape) and the SSE-lag
+    gauge — the cross-process analog of the in-process REGISTRY read the
+    single-shard mode does."""
+    import re
+
+    import requests
+
+    out: Dict[str, Any] = {"route_p99_s": {}, "sse_lag_s_last": None}
+    try:
+        text = requests.get(f"{url}/metrics/prom", timeout=10).text
+    except Exception:  # noqa: BLE001 — a dead shard scrapes as empty
+        return out
+    for line in text.splitlines():
+        m = re.match(_ROUTE_P99_RE, line)
+        if m:
+            out["route_p99_s"][m.group(1)] = round(float(m.group(2)), 6)
+            continue
+        m = re.match(_SSE_LAG_RE, line)
+        if m:
+            out["sse_lag_s_last"] = float(m.group(1))
+    return out
+
+
+def run_sharded(*, shards: int = SHARDS, frontends: int = FRONTENDS,
+                clients: int = CLIENTS,
+                jobs_per_client: int = JOBS_PER_CLIENT,
+                sse_fraction: float = SSE_FRACTION,
+                executors: int = EXECUTORS) -> Dict[str, Any]:
+    """The sharded topology under the SAME client loops: N shard
+    subprocesses + M front-end subprocesses (runtime/fleet.ShardFleet),
+    clients round-robin over the front ends."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import requests
+
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.fleet import (
+        ShardFleet,
+    )
+    from cs230_distributed_machine_learning_tpu.utils.config import (
+        get_config,
+    )
+
+    materialize_builtin("iris")  # shared storage root: every shard sees it
+    root = get_config().storage.root
+    fleet = ShardFleet(
+        shards,
+        storage_root=root,
+        n_frontends=max(frontends, 1),
+        local_executors=max(executors, 1),
+        journal=False,  # parity with the single-shard "before" config
+        log_dir=os.path.join(root, "loadtest-logs"),
+    )
+    payload = _make_payload()
+    try:
+        fleet.start()
+        fes = fleet.frontend_urls
+
+        # warm EVERY shard (each has its own executable/dataset caches):
+        # mint sessions until each shard index answered one warm job
+        warmed = set()
+        for _ in range(32 * shards):
+            if len(warmed) == shards:
+                break
+            body = requests.post(
+                f"{fes[0]}/create_session", timeout=60
+            ).json()
+            k = body.get("shard")
+            if k in warmed:
+                continue
+            _warm_job(fes[0], body["session_id"], payload)
+            warmed.add(k)
+
+        stats, wall = _drive(
+            fes, payload, clients=clients, jobs_per_client=jobs_per_client,
+            sse_fraction=sse_fraction,
+        )
+
+        per_shard = {
+            k: _scrape_shard(u) for k, u in enumerate(fleet.shard_urls)
+        }
+        jobs_per_shard = {}
+        for k, u in enumerate(fleet.shard_urls):
+            try:
+                jobs_per_shard[k] = len(
+                    requests.get(f"{u}/jobs", timeout=10).json()
+                )
+            except Exception:  # noqa: BLE001
+                jobs_per_shard[k] = None
+    finally:
+        fleet.stop()
+
+    n_jobs = stats.completed
+    routes = sorted(
+        {r for s in per_shard.values() for r in s["route_p99_s"]}
+    )
+    out = {
+        "benchmark": f"loadtest_{shards}shard",
+        "config": {
+            "shards": shards,
+            "frontends": max(frontends, 1),
+            "clients": clients,
+            "jobs_per_client": jobs_per_client,
+            "sse_fraction": sse_fraction,
+            "executors_per_shard": max(executors, 1),
+            "poll_interval_s": POLL_S,
+            "job_shape": "iris LogisticRegression GridSearchCV 2 trials cv=2",
+        },
+        "backend": "cpu",
+        "wall_s": round(wall, 3),
+        "jobs": {
+            "target": clients * jobs_per_client,
+            "completed": stats.completed,
+            "failed": stats.failed,
+            "rejected_429_retries": stats.rejected_429,
+        },
+        "jobs_per_second": round(n_jobs / wall, 3) if wall > 0 else None,
+        "latency_s": {
+            "submit": lat_stats(stats.submit),
+            "status_poll": lat_stats(stats.poll),
+            "sse_first_event": lat_stats(stats.sse_first),
+            "job_completion": lat_stats(stats.job_wall),
+        },
+        "server_observed": {
+            # worst shard per route: the fleet's p99 is bounded by it
+            "route_p99_s_max_over_shards": {
+                r: max(
+                    s["route_p99_s"][r]
+                    for s in per_shard.values() if r in s["route_p99_s"]
+                )
+                for r in routes
+            },
+            "per_shard": per_shard,
+        },
+        "routing": {"jobs_per_shard": jobs_per_shard},
+        "errors": stats.errors[:20],
+        "note": (
+            f"ROADMAP item 2 acceptance artifact: {shards} coordinator-"
+            f"shard subprocesses (own GIL + journal partition each, "
+            f"admission caps carved fleet-wide) behind "
+            f"{max(frontends, 1)} stateless front-end subprocesses; "
+            "clients round-robin over the front ends, so every request "
+            "pays the proxy hop. Same harness, client count, and job "
+            "shape as loadtest_single_shard.json; must beat its "
+            "jobs_per_second AND submit p99 AND status_poll p99. "
+            "sse_first_event also reflects the SSE snapshot-padding fix "
+            "measured with an unbuffered client read."
+        ),
+    }
+    return out
+
+
+def _smoke_check(out: Dict[str, Any]) -> List[str]:
+    """Functional assertions for the CI sharded smoke (no latency gate)."""
+    problems = []
+    jobs = out["jobs"]
+    if jobs["completed"] != jobs["target"]:
+        problems.append(
+            f"completed {jobs['completed']} != target {jobs['target']}"
+        )
+    if jobs["failed"]:
+        problems.append(f"{jobs['failed']} failed jobs")
+    if out.get("errors"):
+        problems.append(f"client errors: {out['errors'][:3]}")
+    per_shard = (out.get("routing") or {}).get("jobs_per_shard") or {}
+    for k, n in per_shard.items():
+        if not n:
+            problems.append(f"shard {k} received no jobs (routing broken?)")
+    return problems
+
+
 def _backend() -> str:
     import jax
 
@@ -333,16 +565,34 @@ def _backend() -> str:
 
 
 def main() -> None:
-    out = run()
-    with open(OUT, "w") as f:
+    import argparse
+
+    parser = argparse.ArgumentParser(description="control-plane load test")
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="assert completion + routing (CI gate), no latency gate",
+    )
+    args = parser.parse_args()
+
+    out = run_sharded() if SHARDS >= 2 else run()
+    path = _out_path(SHARDS)
+    with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({
         "jobs_per_second": out["jobs_per_second"],
         "submit_p99_s": out["latency_s"]["submit"]["p99_s"],
         "poll_p99_s": out["latency_s"]["status_poll"]["p99_s"],
+        "sse_first_p50_s": out["latency_s"]["sse_first_event"]["p50_s"],
         "completed": out["jobs"]["completed"],
         "failed": out["jobs"]["failed"],
+        "out": path,
     }))
+    if args.smoke:
+        problems = _smoke_check(out)
+        if problems:
+            print("SMOKE FAILED: " + "; ".join(problems))
+            sys.exit(1)
+        print("smoke ok")
 
 
 if __name__ == "__main__":

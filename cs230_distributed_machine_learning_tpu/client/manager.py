@@ -35,8 +35,18 @@ from .introspection import extract_model_details
 
 
 class MLTaskManager:
-    def __init__(self, url: Optional[str] = None, coordinator=None):
+    def __init__(
+        self,
+        url: Optional[str] = None,
+        coordinator=None,
+        priority: int = 0,
+    ):
+        """``priority`` is this session's QoS lane (docs/ARCHITECTURE.md
+        "QoS priority lanes"): subtasks of its jobs dispatch ahead of
+        lower lanes when the fleet is backlogged. Default 0 keeps the
+        legacy FIFO behavior."""
         self.api_url = url.rstrip("/") if url else None
+        self.priority = int(priority)
         if self.api_url is None:
             if coordinator is None:
                 from ..runtime.coordinator import Coordinator
@@ -57,8 +67,13 @@ class MLTaskManager:
 
     def _create_session(self) -> str:
         if self._coordinator is not None:
-            return self._coordinator.create_session()
-        resp = self._request("post", "create_session")
+            return self._coordinator.create_session(
+                priority=self.priority
+            )
+        resp = self._request(
+            "post", "create_session",
+            json={"priority": self.priority} if self.priority else None,
+        )
         return resp["session_id"]
 
     # ------------- data management -------------
@@ -204,6 +219,11 @@ class MLTaskManager:
                 "post", f"train/{self.session_id}", json=json_safe(payload),
                 headers={TRACE_HEADER: self.trace_id}, idempotent=True,
             )
+        # adopt the CANONICAL job id: a sharded coordinator stamps the
+        # client-minted id with its shard (``s<k>-``) so any front end
+        # routes follow-up status/SSE/model requests without a lookup
+        # (runtime/sharding.py); unsharded coordinators echo the id back
+        self.job_id = submit.get("job_id") or self.job_id
         if not wait_for_completion:
             return submit
         if stream and self._coordinator is not None:
@@ -378,6 +398,11 @@ class MLTaskManager:
                             continue
                         last = event
                         attempt = 0  # real progress resets the backoff
+                        # progress events carry the canonical (shard-
+                        # stamped) job id — adopt it so post-stream
+                        # status/model calls route through any front end
+                        if event.get("job_id"):
+                            self.job_id = event["job_id"]
                         if bar is not None:
                             bar.n = int(_pct(event.get("job_status")))
                             _bar_postfix(bar, event)

@@ -126,18 +126,16 @@ def weighted_balanced_accuracy(y_true, y_pred, w, n_classes):
 
 def weighted_log_loss(y_true, proba, w, n_classes):
     """log_loss over kept rows: -mean log p(true class), with f32-eps
-    probability clipping then row renormalization. NEAR-parity with
-    sklearn, not exact: sklearn >= 1.5 normalizes rows FIRST and then
-    clips (no renormalize after), with eps from the input dtype — the two
-    orders diverge by O(eps) and only at saturated probabilities, which
-    is inside every kernel's solver tolerance but can differ in the last
-    ulps there."""
+    probability clipping and NO renormalization — sklearn >= 1.5 order
+    (clip only; non-normalized rows merely warn there). For normalized
+    f32 probabilities this is EXACT parity with
+    ``sklearn.metrics.log_loss`` on the same f32 input, including
+    saturated rows (an exact 0 clips to eps, an exact 1 to 1-eps —
+    pinned in tests/test_scoring.py); the old clip-then-renormalize
+    order diverged by O(eps) exactly there (ADVICE r5 #4)."""
     w = w.astype(jnp.float32)
     eps = jnp.finfo(jnp.float32).eps
     p = jnp.clip(proba, eps, 1.0 - eps)
-    # clip-then-renormalize (sklearn normalizes first, then clips — the
-    # O(eps) divergence is documented above)
-    p = p / jnp.sum(p, axis=1, keepdims=True)
     classes = jnp.arange(n_classes)
     oh = (y_true[:, None] == classes[None, :]).astype(jnp.float32)
     ll = -jnp.sum(oh * jnp.log(p), axis=1)

@@ -55,7 +55,11 @@ class ExecutorWorker:
         self.executor = executor
         self.worker_id = worker_id
         self._stop = threading.Event()
-        self._sub = cluster.bus.subscribe(TOPIC_TRAIN, key_filter=lambda k: k == worker_id)
+        # priority=True: the worker drains its keyed queue highest QoS
+        # lane first (docs/ARCHITECTURE.md "QoS priority lanes")
+        self._sub = cluster.bus.subscribe(
+            TOPIC_TRAIN, key_filter=lambda k: k == worker_id, priority=True
+        )
         self._threads: List[threading.Thread] = []
 
     def start(self) -> None:
@@ -146,14 +150,25 @@ class ExecutorWorker:
 
 
 class ClusterRuntime:
-    def __init__(self, *, cache=None, predictor=None):
+    def __init__(self, *, cache=None, predictor=None, shard_id=None):
         self.bus = TopicBus()
         #: shared attempt/exclusion/poison accounting: the engine bumps it
         #: on lease reclaims/requeues/speculation, the coordinator on
         #: failure retries; one ledger keeps attempt ids monotonic
         self.ledger = AttemptLedger()
+        #: shard identity (sharded control plane, runtime/sharding.py):
+        #: stamps minted worker ids so front ends route worker-plane
+        #: traffic statelessly; None = the unsharded single-coordinator
+        #: topology, ids unchanged
+        self.shard_id = shard_id
+        prefix = ""
+        if shard_id is not None:
+            from .sharding import worker_prefix
+
+            prefix = worker_prefix(int(shard_id))
         self.engine = PlacementEngine(
-            bus=self.bus, predictor=predictor, ledger=self.ledger
+            bus=self.bus, predictor=predictor, ledger=self.ledger,
+            worker_prefix=prefix,
         )
         self.engine.on_evict = self._on_worker_evicted
         self.cache = cache
@@ -294,7 +309,7 @@ class ClusterRuntime:
     def register_remote(self, mem_capacity_mb: Optional[float] = None) -> str:
         wid = self.engine.subscribe(mem_capacity_mb=mem_capacity_mb)
         self._remote_subs[wid] = self.bus.subscribe(
-            TOPIC_TRAIN, key_filter=lambda k, w=wid: k == w
+            TOPIC_TRAIN, key_filter=lambda k, w=wid: k == w, priority=True
         )
         return wid
 
@@ -447,7 +462,11 @@ class ClusterRuntime:
     # ---------------- internal loops ----------------
 
     def _ingress_loop(self) -> None:
-        sub = self.bus.subscribe(TOPIC_TASKS)
+        # priority=True: under a placement backlog, higher-QoS sessions'
+        # subtasks reach the engine first (retries/requeues keep the
+        # priority their spec was stamped with, so the lane survives the
+        # whole retry-budget machinery)
+        sub = self.bus.subscribe(TOPIC_TASKS, priority=True)
         while not self._stop.is_set():
             try:
                 _, task = sub.get(timeout=0.2)

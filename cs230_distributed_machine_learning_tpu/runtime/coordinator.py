@@ -56,16 +56,32 @@ class Coordinator:
         executor: Optional[LocalExecutor] = None,
         cluster=None,
         journal: bool = False,
+        journal_dir: Optional[str] = None,
+        shard_id: Optional[int] = None,
+        n_shards: int = 1,
     ):
         """Two dispatch modes: direct (default — one in-process executor, the
         single-host TPU deployment) and scheduled (``cluster=`` a
         ClusterRuntime — placement-engine dispatch over an executor pool
-        with heartbeats/requeue, the reference's full topology)."""
+        with heartbeats/requeue, the reference's full topology).
+
+        ``shard_id``/``n_shards`` make this coordinator ONE shard of a
+        sharded control plane (docs/ARCHITECTURE.md "Sharded control
+        plane"): job ids are stamped ``s<shard>-`` so any stateless front
+        end routes them, and ``journal_dir`` points at this shard's OWN
+        journal (``<journal>/shard-<k>``) — the unit of hot-standby
+        takeover (a replacement process replaying it finishes the dead
+        shard's jobs, docs/ROBUSTNESS.md "Shard takeover")."""
         self.config = config or get_config()
         self.cluster = cluster
+        self.shard_id = shard_id
+        self.n_shards = max(int(n_shards), 1)
         self.bus = cluster.bus if cluster is not None else TopicBus()
         self.store = JobStore(
-            journal_dir=self.config.storage.journal_dir if journal else None
+            journal_dir=(
+                (journal_dir or self.config.storage.journal_dir)
+                if journal else None
+            )
         )
         self.cache = (
             cluster.cache
@@ -298,8 +314,39 @@ class Coordinator:
 
     # ------------- session / data management (master.py:56-112 parity) -------------
 
-    def create_session(self) -> str:
-        return self.store.create_session()
+    def create_session(
+        self,
+        session_id: Optional[str] = None,
+        priority: int = 0,
+    ) -> str:
+        """``session_id`` lets a sharded front end mint the id (so
+        ``shard_of(session_id)`` and the owning shard agree by
+        construction); ``priority`` is the session's QoS lane — its jobs'
+        subtasks dispatch ahead of lower lanes (docs/ARCHITECTURE.md
+        "QoS priority lanes")."""
+        if session_id is None and self.shard_id is not None:
+            # a shard minting its own session id must mint one that
+            # HASHES here — otherwise every front end would route the
+            # session elsewhere and it would be unreachable through the
+            # fleet. Rejection-sample (expected n_shards draws).
+            from .sharding import shard_of
+
+            while True:
+                session_id = str(uuid.uuid4())
+                if shard_of(session_id, self.n_shards) == self.shard_id:
+                    break
+        return self.store.create_session(session_id, priority=priority)
+
+    def canonical_job_id(self, job_id: str) -> str:
+        """The id a job is stored and routed under: on a shard, client-
+        minted ids gain this shard's ``s<k>-`` stamp (deterministic, so
+        idempotent-resubmit dedupe survives sharding); already-stamped
+        and unsharded ids pass through."""
+        if self.shard_id is None or not job_id:
+            return job_id
+        from .sharding import stamp_job_id
+
+        return stamp_job_id(self.shard_id, job_id)
 
     def check_session(self, sid: str) -> bool:
         return self.store.has_session(sid)
@@ -358,7 +405,9 @@ class Coordinator:
         Payload schema matches the reference client (core.py:152-174):
         {job_id?, dataset_id, model_details, train_params}."""
         self._require_session(sid)
-        job_id = payload.get("job_id") or str(uuid.uuid4())
+        job_id = self.canonical_job_id(
+            payload.get("job_id") or str(uuid.uuid4())
+        )
         if payload.get("job_id"):
             # idempotent resubmit: the client minted this job_id and is
             # retrying a submit whose response it never saw (coordinator
@@ -433,8 +482,17 @@ class Coordinator:
                 subtasks = create_subtasks(
                     job_id, sid, dataset_id, model_details, train_params
                 )
+            # QoS lane: the payload may override, else the session's
+            # priority rides every subtask spec — the dispatch queues
+            # (task ingress + per-worker train queues) order on it, and
+            # retries/requeues/speculation copy the spec so the lane
+            # survives the whole fault-tolerance machinery
+            priority = payload.get("priority")
+            if priority is None:
+                priority = self.store.session_priority(sid)
             for st in subtasks:
                 st["trace_id"] = trace_id
+                st["priority"] = int(priority or 0)
             sub_sp.attrs["total_subtasks"] = len(subtasks)
             try:
                 metadata = self.cache.metadata(dataset_id)

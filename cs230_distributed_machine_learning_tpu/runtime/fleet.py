@@ -1,0 +1,196 @@
+"""Shard-fleet process launcher: N coordinator shards + M front ends.
+
+The operational glue of the sharded control plane (docs/ARCHITECTURE.md
+"Sharded control plane"), shared by the load-test harness
+(benchmarks/loadtest.py), the CI sharded smoke (deploy/ci.sh), and the
+shard-kill chaos drill (tests/test_chaos.py). Each shard is a REAL
+subprocess — its own interpreter, its own GIL, its own journal under
+``<storage_root>/journal/shard-<k>`` — because sharding only buys
+throughput across processes. Front ends are subprocesses too (they carry
+the proxy CPU cost the benchmark must charge honestly).
+
+``restart_shard(k)`` relaunches a (killed) shard on the SAME port and
+journal directory — the hot-standby takeover path: journal replay +
+``resume_inflight`` finish the dead process's jobs
+(docs/ROBUSTNESS.md "Shard takeover").
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class ShardFleet:
+    def __init__(
+        self,
+        n_shards: int,
+        *,
+        storage_root: str,
+        n_frontends: int = 1,
+        local_executors: int = 1,
+        journal: bool = True,
+        env: Optional[Dict[str, str]] = None,
+        log_dir: Optional[str] = None,
+        host: str = "127.0.0.1",
+    ):
+        from .sharding import MAX_SHARDS
+
+        self.n_shards = int(n_shards)
+        if not 1 <= self.n_shards <= MAX_SHARDS:
+            raise ValueError(
+                f"n_shards must be in [1, {MAX_SHARDS}] (id stamp grammar)"
+            )
+        self.host = host
+        self.local_executors = int(local_executors)
+        self.journal = journal
+        self.storage_root = storage_root
+        self.log_dir = log_dir or storage_root
+        os.makedirs(self.log_dir, exist_ok=True)
+        # child processes must import the package no matter where the
+        # PARENT runs from (an uninstalled checkout driven from a scratch
+        # cwd): prepend the package's own root to PYTHONPATH
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        ))
+        self.env = {
+            **os.environ,
+            "TPUML_STORAGE__ROOT": storage_root,
+            "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+            "PYTHONPATH": pkg_root + (
+                os.pathsep + os.environ["PYTHONPATH"]
+                if os.environ.get("PYTHONPATH") else ""
+            ),
+            **(env or {}),
+        }
+        self.shard_ports = [free_port() for _ in range(self.n_shards)]
+        self.frontend_ports = [free_port() for _ in range(int(n_frontends))]
+        self.shard_procs: List[Optional[subprocess.Popen]] = [
+            None
+        ] * self.n_shards
+        self.frontend_procs: List[subprocess.Popen] = []
+
+    # ---------------- addresses ----------------
+
+    @property
+    def shard_urls(self) -> List[str]:
+        return [f"http://{self.host}:{p}" for p in self.shard_ports]
+
+    @property
+    def frontend_urls(self) -> List[str]:
+        return [f"http://{self.host}:{p}" for p in self.frontend_ports]
+
+    # ---------------- lifecycle ----------------
+
+    def _log(self, name: str):
+        return open(os.path.join(self.log_dir, f"{name}.log"), "ab")
+
+    def start_shard(self, k: int) -> subprocess.Popen:
+        cmd = [
+            sys.executable, "-m",
+            "cs230_distributed_machine_learning_tpu.runtime.server",
+            "--host", self.host, "--port", str(self.shard_ports[k]),
+            "--shard-index", str(k), "--num-shards", str(self.n_shards),
+            "--local-executors", str(self.local_executors),
+        ]
+        if self.journal:
+            cmd.append("--journal")
+        proc = subprocess.Popen(
+            cmd, env=self.env,
+            stdout=self._log(f"shard-{k}"), stderr=subprocess.STDOUT,
+        )
+        self.shard_procs[k] = proc
+        return proc
+
+    def start(self, timeout_s: float = 300.0) -> "ShardFleet":
+        for k in range(self.n_shards):
+            self.start_shard(k)
+        shard_list = ",".join(self.shard_urls)
+        for i, port in enumerate(self.frontend_ports):
+            self.frontend_procs.append(subprocess.Popen(
+                [
+                    sys.executable, "-m",
+                    "cs230_distributed_machine_learning_tpu.runtime.frontend",
+                    "--host", self.host, "--port", str(port),
+                    "--shards", shard_list,
+                ],
+                env=self.env,
+                stdout=self._log(f"frontend-{i}"), stderr=subprocess.STDOUT,
+            ))
+        self.wait_ready(timeout_s)
+        return self
+
+    def wait_ready(self, timeout_s: float = 300.0) -> None:
+        import requests
+
+        deadline = time.time() + timeout_s
+        # front ends are ready exactly when every shard is (their /readyz
+        # aggregates), so gating on them gates on the whole fleet
+        for url in self.frontend_urls or self.shard_urls:
+            while True:
+                try:
+                    if requests.get(f"{url}/readyz", timeout=2).status_code == 200:
+                        break
+                except Exception:  # noqa: BLE001 — still booting
+                    pass
+                if time.time() > deadline:
+                    raise TimeoutError(f"fleet at {url} never became ready")
+                time.sleep(0.3)
+
+    def kill_shard(self, k: int, sig: int = signal.SIGKILL) -> None:
+        proc = self.shard_procs[k]
+        if proc is not None:
+            proc.send_signal(sig)
+            proc.wait(timeout=30)
+
+    def restart_shard(self, k: int, timeout_s: float = 300.0) -> None:
+        """Hot-standby takeover: a fresh process on the dead shard's port
+        and journal dir; returns once its /readyz (journal replayed,
+        in-flight jobs re-queued) answers 200."""
+        import requests
+
+        self.start_shard(k)
+        url = self.shard_urls[k]
+        deadline = time.time() + timeout_s
+        while True:
+            try:
+                if requests.get(f"{url}/readyz", timeout=2).status_code == 200:
+                    return
+            except Exception:  # noqa: BLE001
+                pass
+            if time.time() > deadline:
+                raise TimeoutError(f"shard {k} never recovered at {url}")
+            time.sleep(0.3)
+
+    def stop(self) -> None:
+        procs = [p for p in self.shard_procs if p is not None]
+        procs += self.frontend_procs
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGKILL)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def __enter__(self) -> "ShardFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

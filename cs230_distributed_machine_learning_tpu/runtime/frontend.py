@@ -1,0 +1,553 @@
+"""Stateless API front end for the sharded control plane.
+
+The thin half of the front/core split (docs/ARCHITECTURE.md "Sharded
+control plane"): this process holds NO job state — every request is
+routed to a coordinator shard using only the ids already in the URL
+(runtime/sharding.py):
+
+- session routes (``/train/<sid>``, ``/train_status/<sid>``,
+  ``/check_status/<sid>/<jid>``, ...) route by ``shard_of(session_id)``;
+  ``/create_session`` MINTS the session id here, so the hash and the
+  owning shard agree by construction;
+- job-only routes (``/trace/<jid>``, ``/cost/<jid>``, ``/explain/...``)
+  route by the ``s<k>-`` stamp the owning shard minted into the job id
+  (unstamped ids fall back to a scatter probe);
+- worker-plane routes (``/subscribe``, ``/next_tasks/<wid>``,
+  ``/task_result/<wid>``, ...) route by the same stamp in the worker id;
+  ``/subscribe`` assigns the worker to a shard (body ``{"shard": k}``
+  pins it, else round-robin) — the shard's engine mints the stamped id;
+- fleet-wide concerns aggregate over every shard: ``/healthz`` (worst
+  status wins), ``/readyz`` (ready only when EVERY shard is), ``/jobs``
+  / ``/workers`` / ``/queues`` (merged), and ``/metrics/prom`` (one
+  exposition with a ``shard`` label injected per series).
+
+Because no state lives here, any number of front ends can run against
+the same shard fleet, restart freely, and serve any client: a job
+submitted through one front end is visible and streamable through every
+other (pinned in tests/test_sharding.py). A shard that is down answers
+as 503 + Retry-After — the same overload contract clients already retry
+through (docs/ROBUSTNESS.md) — so a killed shard's takeover process
+slots back in with no front-end restart.
+
+Run: ``tpuml-frontend --port 5000 --shards http://h1:5001,http://h2:5001``
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import get_logger
+from ..utils.serialization import json_safe
+from .sharding import id_shard, shard_of
+
+logger = get_logger("tpuml.frontend")
+
+#: URL prefixes routed by the session id in the first path argument
+_SESSION_ROUTES = {
+    "download_data", "check_data", "preprocess", "train", "train_status",
+    "check_status", "download_model",
+}
+#: routed by the worker-id stamp in the first path argument
+_WORKER_ROUTES = {
+    "unsubscribe", "heartbeat", "next_tasks", "task_result", "task_metrics",
+    "trace_spans",
+}
+#: routed by the job-id stamp (scatter probe for unstamped ids)
+_JOB_ROUTES = {"trace", "cost", "explain"}
+#: response headers forwarded from the shard to the client
+_FWD_HEADERS = (
+    "Content-Type", "Retry-After", "X-Trace-Id", "X-Dataset-Kind",
+    "Content-Disposition",
+)
+
+
+def _inject_shard_label(body: str, shard: int) -> List[str]:
+    """Rewrite one shard's Prometheus exposition so every series carries
+    a ``shard=<k>`` label — the merge that keeps identical series from N
+    shards distinct in one scrape. Comment/metadata lines pass through
+    (the caller dedups them)."""
+    out = []
+    for line in body.splitlines():
+        if not line.strip() or line.startswith("#"):
+            out.append(line)
+            continue
+        name, _, rest = line.partition(" ")
+        if "{" in name:
+            fam, _, labels = name.partition("{")
+            out.append(f'{fam}{{shard="{shard}",{labels} {rest}')
+        else:
+            out.append(f'{name}{{shard="{shard}"}} {rest}')
+    return out
+
+
+def create_frontend_app(shard_urls: List[str]):
+    import requests
+    from werkzeug.wrappers import Request, Response
+
+    urls = [u.rstrip("/") for u in shard_urls]
+    if not urls:
+        raise ValueError("frontend needs at least one shard URL")
+    n_shards = len(urls)
+
+    # pooled connections sized for hundreds of concurrent client threads
+    # fanning into a handful of shards
+    session = requests.Session()
+    adapter = requests.adapters.HTTPAdapter(
+        pool_connections=max(2 * n_shards, 4), pool_maxsize=256
+    )
+    session.mount("http://", adapter)
+    session.mount("https://", adapter)
+
+    #: round-robin cursor for /subscribe shard assignment
+    _rr = itertools.count()
+
+    # one shared pool for every fan-out route (/healthz, /jobs,
+    # /metrics/prom, ...): these are POLLED endpoints, and spawning +
+    # joining n_shards fresh threads per hit would put constant thread
+    # churn on exactly the liveness paths
+    from concurrent.futures import ThreadPoolExecutor
+
+    fan_pool = ThreadPoolExecutor(
+        max_workers=max(2 * n_shards, 4),
+        thread_name_prefix="tpuml-fe-fan",
+    )
+
+    def _json(data, status=200, headers=None):
+        return Response(
+            json.dumps(json_safe(data)), status=status,
+            mimetype="application/json", headers=headers,
+        )
+
+    def _shard_down(k: int) -> Response:
+        # same contract as an overloaded/recovering coordinator: clients
+        # (MLTaskManager, agents) already retry 503 + Retry-After, so a
+        # dead shard's takeover window looks like a brief overload
+        return _json(
+            {"status": "error", "reason": "shard_unavailable", "shard": k,
+             "retry_after_s": 2.0},
+            status=503, headers={"Retry-After": "2"},
+        )
+
+    def _upstream(request, k: int, path: str, *, body: Optional[bytes] = None,
+                  stream: bool = False, timeout: Tuple[float, float] = (10, 910)):
+        headers = {}
+        for h in ("Content-Type", "X-Trace-Id"):
+            v = request.headers.get(h)
+            if v:
+                headers[h] = v
+        return session.request(
+            request.method,
+            f"{urls[k]}{path}",
+            params=request.query_string.decode() or None,
+            data=request.get_data() if body is None else body,
+            headers=headers,
+            stream=stream,
+            timeout=timeout,
+        )
+
+    def _relay(upstream, stream: bool = False) -> Response:
+        headers = {
+            h: upstream.headers[h] for h in _FWD_HEADERS
+            if h in upstream.headers
+        }
+        if not stream:
+            body = upstream.content
+            upstream.close()
+            return Response(
+                body, status=upstream.status_code, headers=headers
+            )
+
+        def _body():
+            # unbuffered relay: read1 hands over whatever bytes the shard
+            # already flushed (an SSE event) instead of blocking until a
+            # full buffer accumulates — the same time-to-first-event
+            # hazard the coordinator's padding prologue defeats must not
+            # be reintroduced by this hop. read(1) is the (slow, correct)
+            # fallback for urllib3 builds without read1.
+            raw = upstream.raw
+            read1 = getattr(raw, "read1", None)
+            try:
+                if read1 is not None:
+                    while True:
+                        chunk = read1(65536)
+                        if not chunk:
+                            return
+                        yield chunk
+                else:
+                    while True:
+                        b = raw.read(1)
+                        if not b:
+                            return
+                        yield b
+            finally:
+                upstream.close()
+
+        return Response(
+            _body(), status=upstream.status_code, headers=headers,
+            direct_passthrough=True,
+        )
+
+    def _proxy(request, k: int, path: str, *, body: Optional[bytes] = None,
+               stream: bool = False) -> Response:
+        try:
+            upstream = _upstream(request, k, path, body=body, stream=stream)
+        except requests.RequestException:
+            return _shard_down(k)
+        return _relay(upstream, stream=stream)
+
+    def _fan_json(request, path: str) -> Dict[int, Any]:
+        """GET ``path`` on every shard CONCURRENTLY; {shard: parsed body}
+        for the ones that answered (HTTP errors/outages are simply
+        absent). Concurrency matters: a sequential loop would let one
+        hung shard stall every aggregate route (/healthz, /jobs,
+        /metrics/prom, the /readyz fleet gate) by its full timeout."""
+        qs = request.query_string.decode() or None
+
+        def _one(k: int):
+            try:
+                r = session.get(
+                    f"{urls[k]}{path}", params=qs, timeout=10
+                )
+                return k, (r.json() if r.ok else None)
+            except requests.RequestException:
+                return k, None
+
+        results = list(fan_pool.map(_one, range(n_shards)))
+        return {k: body for k, body in results if body is not None}
+
+    def _scatter_first(request, path: str, stream: bool = False) -> Response:
+        """Try every shard in order; first non-404 answer wins (job-stamp
+        fallback for unstamped ids, and /dataset, which any shard sharing
+        the storage root can serve)."""
+        last: Optional[Response] = None
+        for k in range(n_shards):
+            try:
+                upstream = _upstream(request, k, path, stream=stream)
+            except requests.RequestException:
+                last = _shard_down(k)
+                continue
+            if upstream.status_code == 404:
+                upstream.close()
+                continue
+            return _relay(upstream, stream=stream)
+        return last if last is not None else _json(
+            {"status": "error", "message": "not found on any shard"},
+            status=404,
+        )
+
+    # ---------------- fleet-wide aggregates ----------------
+
+    def _home(request):
+        return _json({
+            "service": "tpuml-frontend",
+            "n_shards": n_shards,
+            "shards": urls,
+            "note": "stateless front end: session routes hash on "
+                    "session_id, job/worker routes follow the s<k>- id "
+                    "stamp; /healthz, /jobs, /workers, /queues and "
+                    "/metrics/prom aggregate over every shard",
+        })
+
+    def _health(request):
+        shards = _fan_json(request, "/health")
+        degraded = [
+            k for k in range(n_shards)
+            if shards.get(k, {}).get("status") != "ok"
+        ]
+        return _json({
+            "status": "ok" if not degraded else "degraded",
+            "n_shards": n_shards,
+            "shards_unhealthy": degraded,
+        })
+
+    def _readyz(request):
+        shards = _fan_json(request, "/readyz")
+        ready = [k for k in shards if shards[k].get("status") == "ready"]
+        if len(ready) == n_shards:
+            return _json({"status": "ready", "n_shards": n_shards})
+        return _json(
+            {"status": "recovering", "n_shards": n_shards,
+             "shards_ready": sorted(ready)},
+            status=503, headers={"Retry-After": "2"},
+        )
+
+    def _healthz(request):
+        shards = _fan_json(request, "/healthz")
+        status = "ok"
+        if len(shards) < n_shards or any(
+            s.get("status") != "ok" for s in shards.values()
+        ):
+            status = "degraded"
+        return _json({
+            "status": status,
+            "n_shards": n_shards,
+            "shards_down": [k for k in range(n_shards) if k not in shards],
+            "n_workers": sum(
+                int(s.get("n_workers") or 0) for s in shards.values()
+            ),
+            "shards": shards,
+        })
+
+    def _jobs(request):
+        merged: List[Dict[str, Any]] = []
+        for body in _fan_json(request, "/jobs").values():
+            if isinstance(body, list):
+                merged.extend(body)
+        merged.sort(key=lambda j: j.get("created_at") or 0, reverse=True)
+        return _json(merged)
+
+    def _merge_dicts(request, path: str):
+        merged: Dict[str, Any] = {}
+        for body in _fan_json(request, path).values():
+            if isinstance(body, dict):
+                merged.update(body)  # worker ids are shard-stamped: unique
+        return _json(merged)
+
+    def _metrics_prom(request):
+        def _scrape(k: int):
+            try:
+                r = session.get(f"{urls[k]}/metrics/prom", timeout=10)
+                r.raise_for_status()
+                return k, r.text
+            except requests.RequestException:
+                return k, None
+
+        bodies = list(fan_pool.map(_scrape, range(n_shards)))
+        lines: List[str] = []
+        seen_meta = set()
+        for k, text in bodies:
+            if text is None:
+                continue
+            for line in _inject_shard_label(text, k):
+                if line.startswith("#"):
+                    if line in seen_meta:
+                        continue
+                    seen_meta.add(line)
+                lines.append(line)
+        return Response(
+            "\n".join(lines) + "\n",
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )
+
+    def _dashboard(request):
+        from .server import _DASHBOARD_HTML
+
+        # same self-contained page: every endpoint it polls exists here
+        # (aggregated), and job-stamped /trace//cost route to the owner
+        return Response(_DASHBOARD_HTML, mimetype="text/html")
+
+    def _scatter_dict(request, path: str):
+        return _json({"shards": _fan_json(request, path)})
+
+    # dashboard-compatible aggregates: the /dashboard JS polls these
+    # expecting the COORDINATOR's response shapes, so the front end must
+    # merge into the same shapes (not the raw {"shards": ...} scatter)
+
+    def _events(request):
+        merged: List[Dict[str, Any]] = []
+        for k, body in _fan_json(request, request.path).items():
+            for e in (body or {}).get("events") or []:
+                e["shard"] = k
+                merged.append(e)
+        # per-shard seqs collide, so order by wall clock; last_seq is
+        # meaningless fleet-wide (pollers should cursor per shard)
+        merged.sort(key=lambda e: e.get("ts") or 0)
+        return _json(
+            {"events": merged, "n_events": len(merged), "last_seq": 0}
+        )
+
+    def _metrics_history(request):
+        shards = _fan_json(request, request.path)
+        if not request.args.get("name"):
+            names = sorted({
+                n for body in shards.values()
+                for n in (body or {}).get("names") or []
+            })
+            return _json({"names": names})
+        series: List[Dict[str, Any]] = []
+        for k, body in shards.items():
+            for s in (body or {}).get("series") or []:
+                s["labels"] = {**(s.get("labels") or {}), "shard": str(k)}
+                series.append(s)
+        return _json({
+            "name": request.args.get("name"),
+            "since": float(request.args.get("since", 0) or 0),
+            "series": series,
+        })
+
+    def _supervisor(request):
+        merged = []
+        for body in _fan_json(request, request.path).values():
+            if isinstance(body, list):
+                merged.extend(body)
+        return _json(merged)
+
+    # ---------------- the router ----------------
+
+    _cors = {
+        "Access-Control-Allow-Origin": "*",
+        "Access-Control-Allow-Headers": "Content-Type, Authorization",
+        "Access-Control-Allow-Methods": "GET, POST, OPTIONS",
+    }
+
+    def _route(request) -> Response:
+        parts = [p for p in request.path.split("/") if p]
+        if not parts:
+            return _home(request)
+        head = parts[0]
+
+        if head == "create_session":
+            # mint the session id HERE so shard_of(sid) and the owning
+            # shard agree by construction (client-supplied ids are
+            # ignored — honoring them would allow session fixation /
+            # cross-client sharing); forward any QoS priority
+            body = request.get_json(force=True, silent=True) or {}
+            sid = str(uuid.uuid4())
+            k = shard_of(sid, n_shards)
+            fwd = {"session_id": sid}
+            if body.get("priority") is not None:
+                fwd["priority"] = body["priority"]
+            return _proxy(
+                request, k, "/create_session",
+                body=json.dumps(fwd).encode(),
+            )
+
+        if head in _SESSION_ROUTES and len(parts) >= 2:
+            k = shard_of(parts[1], n_shards)
+            return _proxy(
+                request, k, request.path, stream=(head == "train_status")
+            )
+        if head == "metrics" and len(parts) == 3 and parts[1] not in (
+            "prom", "history"
+        ):
+            return _proxy(
+                request, shard_of(parts[1], n_shards), request.path
+            )
+
+        if head in _WORKER_ROUTES and len(parts) >= 2:
+            k = id_shard(parts[1])
+            if k is None or k >= n_shards:
+                return _json(
+                    {"status": "error",
+                     "message": f"worker id {parts[1]!r} carries no valid "
+                                "shard stamp"},
+                    status=404,
+                )
+            return _proxy(request, k, request.path)
+        if head == "subscribe":
+            body = request.get_json(force=True, silent=True) or {}
+            pinned = body.pop("shard", None)
+            if pinned is None:
+                k = next(_rr) % n_shards
+            else:
+                # an explicit pin is a placement intent: reject anything
+                # unroutable instead of silently wrapping modulo N
+                try:
+                    k = int(pinned)
+                except (TypeError, ValueError):
+                    k = -1
+                if not 0 <= k < n_shards:
+                    return _json(
+                        {"status": "error",
+                         "message": f"shard {pinned!r} not in "
+                                    f"[0, {n_shards})"},
+                        status=400,
+                    )
+            return _proxy(
+                request, k, "/subscribe", body=json.dumps(body).encode()
+            )
+
+        if head in _JOB_ROUTES and len(parts) >= 2:
+            k = id_shard(parts[1])
+            if k is not None and k < n_shards:
+                return _proxy(request, k, request.path)
+            return _scatter_first(request, request.path)
+
+        if head == "dataset" and len(parts) == 2:
+            return _scatter_first(request, request.path, stream=True)
+        if head in ("slice_heartbeat", "slice_status") and len(parts) >= 2:
+            return _proxy(
+                request, shard_of(parts[1], n_shards), request.path
+            )
+
+        if head == "health":
+            return _health(request)
+        if head == "livez":
+            return _json({"status": "ok"})
+        if head == "readyz":
+            return _readyz(request)
+        if head == "healthz":
+            return _healthz(request)
+        if head == "jobs":
+            return _jobs(request)
+        if head in ("workers", "queues"):
+            return _merge_dicts(request, request.path)
+        if head == "metrics" and len(parts) == 2 and parts[1] == "prom":
+            return _metrics_prom(request)
+        if head == "dashboard":
+            return _dashboard(request)
+        if head == "events":
+            return _events(request)
+        if head == "supervisor":
+            return _supervisor(request)
+        if head == "metrics" and len(parts) == 2 and parts[1] == "history":
+            return _metrics_history(request)
+        if head == "predictor":
+            # no fleet-wide calibration registry exists: expose the
+            # per-shard bodies keyed by shard index
+            return _scatter_dict(request, request.path)
+
+        return _json(
+            {"status": "error", "message": "not found"}, status=404
+        )
+
+    @Request.application
+    def app(request):
+        if request.method == "OPTIONS":
+            return Response(status=204, headers=_cors)
+        try:
+            resp = _route(request)
+        except Exception as e:  # noqa: BLE001 — a routing bug must answer
+            logger.exception("Frontend routing failed for %s", request.path)
+            resp = _json(
+                {"status": "error", "message": str(e)}, status=500
+            )
+        resp.headers.extend(_cors)
+        return resp
+
+    app.shard_urls = urls
+    return app
+
+
+def serve(shard_urls: List[str], host: str = "0.0.0.0", port: int = 5000):
+    from werkzeug.serving import run_simple
+
+    run_simple(host, port, create_frontend_app(shard_urls), threaded=True)
+
+
+def main() -> None:
+    """``tpuml-frontend`` console entry point: serve the stateless front
+    end of a sharded control plane (docs/ARCHITECTURE.md)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="tpuml API front end")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=5000)
+    parser.add_argument(
+        "--shards", required=True,
+        help="comma-separated coordinator-shard base URLs, in shard order "
+             "(index in this list == shard id)",
+    )
+    args = parser.parse_args()
+    serve(
+        [u for u in args.shards.split(",") if u.strip()],
+        host=args.host, port=args.port,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,7 @@ implementation plugs into for multi-host (runtime/agent.py).
 
 from __future__ import annotations
 
+import itertools
 import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional
@@ -24,9 +25,19 @@ class TopicBus:
         self._subs: Dict[str, List["Subscription"]] = {}
 
     def subscribe(
-        self, topic: str, key_filter: Optional[Callable[[Any], bool]] = None
+        self,
+        topic: str,
+        key_filter: Optional[Callable[[Any], bool]] = None,
+        priority: bool = False,
     ) -> "Subscription":
-        sub = Subscription(self, topic, key_filter)
+        """``priority=True`` makes this subscription a QoS lane consumer
+        (docs/ARCHITECTURE.md "QoS priority lanes"): delivery order is by
+        the message's ``priority`` field (higher first; dict messages
+        only, default lane 0), FIFO within a lane. The dispatch-side
+        subscriptions (task ingress, per-worker train queues) opt in so a
+        heavy tenant's backlog cannot starve a higher-priority session;
+        result/metrics subscriptions stay plain FIFO."""
+        sub = Subscription(self, topic, key_filter, priority=priority)
         with self._lock:
             self._subs.setdefault(topic, []).append(sub)
         return sub
@@ -43,7 +54,7 @@ class TopicBus:
             subs = list(self._subs.get(topic, []))
         for sub in subs:
             if sub.key_filter is None or sub.key_filter(key):
-                sub._q.put((key, message))
+                sub._put(key, message)
                 delivered += 1
         return delivered
 
@@ -66,18 +77,46 @@ class TopicBus:
 
 
 class Subscription:
-    def __init__(self, bus: TopicBus, topic: str, key_filter) -> None:
+    def __init__(
+        self, bus: TopicBus, topic: str, key_filter, priority: bool = False
+    ) -> None:
         self._bus = bus
         self.topic = topic
         self.key_filter = key_filter
-        self._q: "queue.Queue" = queue.Queue()
+        self._priority = priority
+        #: tie-break sequence: FIFO within a priority lane (PriorityQueue
+        #: would otherwise compare the message dicts and raise)
+        self._seq = itertools.count()
+        self._q: "queue.Queue" = (
+            queue.PriorityQueue() if priority else queue.Queue()
+        )
+
+    @staticmethod
+    def _message_priority(message: Any) -> int:
+        if isinstance(message, dict):
+            try:
+                return int(message.get("priority") or 0)
+            except (TypeError, ValueError):
+                return 0
+        return 0
+
+    def _put(self, key: Any, message: Any) -> None:
+        if self._priority:
+            self._q.put(
+                (-self._message_priority(message), next(self._seq),
+                 key, message)
+            )
+        else:
+            self._q.put((key, message))
 
     def get(self, timeout: Optional[float] = None):
         """Returns (key, message); raises queue.Empty on timeout."""
-        return self._q.get(timeout=timeout)
+        item = self._q.get(timeout=timeout)
+        return item[-2:] if self._priority else item
 
     def get_nowait(self):
-        return self._q.get_nowait()
+        item = self._q.get_nowait()
+        return item[-2:] if self._priority else item
 
     def close(self) -> None:
         self._bus.unsubscribe(self)

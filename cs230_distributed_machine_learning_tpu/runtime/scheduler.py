@@ -125,10 +125,15 @@ class PlacementEngine:
         bus=None,
         predictor: Optional[RuntimePredictor] = None,
         ledger: Optional[AttemptLedger] = None,
+        worker_prefix: str = "",
     ):
         cfg = get_config().scheduler
         self.cfg = cfg
         self.bus = bus
+        #: minted worker ids are ``<prefix>worker-<n>``; a coordinator
+        #: shard sets its shard stamp here (runtime/sharding.worker_prefix)
+        #: so front ends can route worker-plane requests statelessly
+        self.worker_prefix = worker_prefix
         self.predictor = predictor or RuntimePredictor()
         #: attempt/exclusion/poison accounting, shared with the coordinator
         #: when a ClusterRuntime wires both to one ledger
@@ -161,7 +166,7 @@ class PlacementEngine:
     def subscribe(self, mem_capacity_mb: Optional[float] = None, worker_id: Optional[str] = None) -> str:
         with self._lock:
             if worker_id is None:
-                worker_id = f"worker-{self._next_id}"
+                worker_id = f"{self.worker_prefix}worker-{self._next_id}"
                 self._next_id += 1
             self.workers[worker_id] = WorkerState(
                 worker_id=worker_id,

@@ -385,6 +385,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
 
     def health(request):
         out = {"status": "ok"}
+        if coord.shard_id is not None:
+            out["shard"] = coord.shard_id
+            out["n_shards"] = coord.n_shards
         sup = getattr(coord, "agent_supervisor", None)
         if sup is not None:
             slots = sup.status()
@@ -397,8 +400,51 @@ def create_app(coordinator: Optional[Coordinator] = None):
                 out["status"] = "degraded"  # every executor slot is down
         return _json(out)
 
+    def _priority_or_400(value, default=0):
+        """Malformed client input must 400, not 500 out of int()."""
+        if value is None:
+            return default
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            from werkzeug.exceptions import BadRequest
+
+            raise BadRequest(f"priority must be an integer, got {value!r}")
+
     def create_session(request):
-        return _json({"session_id": coord.create_session()}, status=201)
+        # optional body {"session_id": ..., "priority": ...}: a sharded
+        # front end mints the session id itself (so shard_of(sid) and the
+        # owning shard agree — runtime/sharding.py) and may carry the
+        # session's QoS lane; a bare POST keeps the legacy mint-here path
+        body = request.get_json(force=True, silent=True) or {}
+        sid_req = body.get("session_id")
+        if sid_req is not None:
+            from werkzeug.exceptions import BadRequest
+
+            if coord.shard_id is None:
+                # unsharded coordinators always mint server-side (the
+                # legacy contract): honoring client ids here would let
+                # two clients silently share — and read — one session
+                # via the idempotent re-create path
+                sid_req = None
+            else:
+                from .sharding import shard_of
+
+                if shard_of(sid_req, coord.n_shards) != coord.shard_id:
+                    # a session stored here but hashing elsewhere would
+                    # be permanently unreachable through the front ends
+                    raise BadRequest(
+                        f"session id {sid_req!r} hashes to shard "
+                        f"{shard_of(sid_req, coord.n_shards)}, not this "
+                        f"shard ({coord.shard_id})"
+                    )
+        sid = coord.create_session(
+            sid_req, priority=_priority_or_400(body.get("priority")),
+        )
+        out = {"session_id": sid}
+        if coord.shard_id is not None:
+            out["shard"] = coord.shard_id
+        return _json(out, status=201)
 
     def download_data(request, sid):
         body = request.get_json(force=True)
@@ -437,24 +483,44 @@ def create_app(coordinator: Optional[Coordinator] = None):
         reject = _admission_reject(sid)
         if reject is not None:
             return reject
-        return _json(coord.submit_train(sid, request.get_json(force=True)))
+        body = request.get_json(force=True)
+        if "priority" in body:
+            body["priority"] = _priority_or_400(body["priority"], None)
+        return _json(coord.submit_train(sid, body))
 
     def train_status(request, sid):
         body = request.get_json(force=True)
         # an SSE RESUME (known job_id) is a read, not new load — it must
         # never be rejected, or a reconnecting client could not follow the
-        # job it already owns through the very overload that dropped it
+        # job it already owns through the very overload that dropped it.
+        # The lookup uses the CANONICAL (shard-stamped) id: a client
+        # resuming under its own minted id must still match.
         known = bool(
-            body.get("job_id") and coord.store.has_job(sid, body["job_id"])
+            body.get("job_id")
+            and coord.store.has_job(
+                sid, coord.canonical_job_id(body["job_id"])
+            )
         )
         if not known:
             reject = _admission_reject(sid)
             if reject is not None:
                 return reject
+        if "priority" in body:
+            body["priority"] = _priority_or_400(body["priority"], None)
         submit = coord.submit_train(sid, body)
         job_id = submit["job_id"]
 
         def stream():
+            # Time-to-first-event: the first progress snapshot is yielded
+            # immediately (stream_status reads before its first tick
+            # sleep), but common SSE clients buffer reads — http.client's
+            # chunked read(amt) blocks until ~amt BYTES accumulate, which
+            # used to delay the first ~150-byte event by 3+ ticks
+            # (loadtest_single_shard.json: sse_first_event p50 4.9 s).
+            # A 2 KB comment prologue (ignored by every SSE parser)
+            # overflows those buffers so the immediate snapshot is
+            # actually DELIVERED immediately.
+            yield ":" + " " * 2048 + "\n\n"
             # SSE-lag SLO signal: the stream's producer yields one event
             # then sleeps one tick, so anything beyond the tick between
             # consecutive yields is delivery lag — store-read time, GIL
@@ -473,7 +539,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return Response(stream(), mimetype="text/event-stream")
 
     def check_status(request, sid, jid):
-        return _json(coord.check_status(sid, jid))
+        # canonicalize like the SSE-resume path: a client polling under
+        # its own minted id must reach the shard-stamped job
+        return _json(coord.check_status(sid, coord.canonical_job_id(jid)))
 
     def metrics(request, sid, jid):
         # ?wait=1: block until the job finalizes before replying — opt-in
@@ -481,6 +549,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
         # every subtask had reported (master.py:325-332). The default stays
         # non-blocking (returns whatever has reported so far); see
         # docs/API.md "Differences from the reference".
+        jid = coord.canonical_job_id(jid)
         if request.args.get("wait"):
             timeout = float(
                 request.args.get("timeout", coord.config.service.client_timeout_s)
@@ -545,7 +614,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
     def cost(request, jid):
         """Per-job device cost report (docs/OBSERVABILITY.md): device-
         seconds, total FLOPs/bytes, HBM high-water, per-group MFU."""
-        report = coord.job_cost(jid)
+        report = coord.job_cost(coord.canonical_job_id(jid))
         if report is None:
             return _json(
                 {"status": "error", "message": f"no job {jid!r}"}, status=404
@@ -563,6 +632,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
             "obs_enabled": obs_enabled(),
             "ready": coord.ready,
         }
+        if coord.shard_id is not None:
+            out["shard"] = coord.shard_id
+            out["n_shards"] = coord.n_shards
         if coord.recovery:
             out["recovery"] = coord.recovery
         if not coord.ready:
@@ -648,7 +720,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
         attempts/retries, speculation, terminal result — 404 when the
         recorder never saw the pair."""
         try:
-            return _json(coord.explain(jid, stid))
+            return _json(coord.explain(coord.canonical_job_id(jid), stid))
         except KeyError as e:
             return _json(
                 {"status": "error", "message": str(e).strip("'")}, status=404
@@ -657,6 +729,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
     def explain_job(request, jid):
         """Subtask ids with a recorded timeline for the job — the
         discovery aid for /explain/<jid>/<stid>."""
+        jid = coord.canonical_job_id(jid)
         stids = RECORDER.job_subtasks(jid)
         if not stids:
             return _json(
@@ -705,6 +778,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return _json(coord.predictor_calibration())
 
     def trace(request, jid):
+        jid = coord.canonical_job_id(jid)
         tid = TRACER.trace_for_job(jid)
         if tid is None:
             return _json(
@@ -733,7 +807,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return _json({"status": "ok", "ingested": n})
 
     def download_model(request, sid, jid):
-        path = coord.best_model_path(sid, jid)
+        path = coord.best_model_path(sid, coord.canonical_job_id(jid))
         if path is None:
             return _json({"status": "error", "message": "no model artifact"}, status=404)
         with open(path, "rb") as f:
@@ -977,9 +1051,30 @@ def main() -> None:
                              "processes (fault-isolated executors)")
     parser.add_argument("--journal", action="store_true",
                         help="journal job state; resume in-flight jobs on restart")
+    # sharded control plane (docs/ARCHITECTURE.md "Sharded control
+    # plane"): this process serves ONE shard of an N-shard fleet behind
+    # stateless front ends (runtime/frontend.py). Job/worker ids get the
+    # s<k>- stamp, the journal moves to <journal_dir>/shard-<k> (the
+    # hot-standby takeover unit), and the GLOBAL admission caps are
+    # carved into per-shard shares so the fleet-wide accepted load stays
+    # bounded by the configured totals.
+    parser.add_argument("--shard-index", type=int, default=None, metavar="K",
+                        help="serve shard K of a sharded control plane")
+    parser.add_argument("--num-shards", type=int, default=1, metavar="N",
+                        help="total shards in the fleet (with --shard-index)")
     args = parser.parse_args()
     if args.direct and args.agent_executors > 0:
         parser.error("--agent-executors requires cluster mode (drop --direct)")
+    if args.shard_index is not None and not (
+        0 <= args.shard_index < max(args.num_shards, 1)
+    ):
+        parser.error("--shard-index must be in [0, --num-shards)")
+    if args.num_shards > 100:
+        # the 2-digit s<k>- stamp grammar bounds the fleet (sharding.py
+        # MAX_SHARDS); fail at launch, not at first unroutable id
+        parser.error("--num-shards is capped at 100 by the id stamp grammar")
+    if args.shard_index is not None and args.direct:
+        parser.error("--shard-index requires cluster mode (drop --direct)")
 
     supervisor = None
     slot_envs = None
@@ -1007,10 +1102,28 @@ def main() -> None:
     else:
         from .cluster import ClusterRuntime
 
-        cluster = ClusterRuntime()
+        shard_kwargs = {}
+        if args.shard_index is not None:
+            import os as _os
+
+            from ..utils.config import get_config as _cfg
+            from .sharding import shard_service_config
+
+            cfg = shard_service_config(_cfg(), args.num_shards)
+            shard_kwargs = {
+                "config": cfg,
+                "shard_id": args.shard_index,
+                "n_shards": args.num_shards,
+                "journal_dir": _os.path.join(
+                    cfg.storage.journal_dir, f"shard-{args.shard_index}"
+                ),
+            }
+        cluster = ClusterRuntime(shard_id=args.shard_index)
         for _ in range(max(args.local_executors, 0)):
             cluster.add_executor()
-        coord = Coordinator(cluster=cluster, journal=args.journal)
+        coord = Coordinator(
+            cluster=cluster, journal=args.journal, **shard_kwargs
+        )
         if args.agent_executors > 0:
             from ..utils.config import get_config as _cfg
             from .supervisor import AgentSupervisor, agent_command

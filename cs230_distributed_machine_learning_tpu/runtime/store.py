@@ -67,14 +67,33 @@ class JobStore:
 
     # ---------------- sessions ----------------
 
-    def create_session(self, session_id: Optional[str] = None) -> str:
+    def create_session(
+        self,
+        session_id: Optional[str] = None,
+        priority: int = 0,
+    ) -> str:
+        """Create (or idempotently re-create) a session. ``session_id`` is
+        accepted from the caller so a sharded front end can mint the id
+        itself and route by ``shard_of(session_id)`` (runtime/sharding.py).
+        ``priority`` is the session's QoS lane (docs/ARCHITECTURE.md
+        "QoS priority lanes"): higher dispatches first; jobs inherit it
+        unless their payload overrides."""
         sid = session_id or str(uuid.uuid4())
         with self._lock:
             self._sessions.setdefault(
-                sid, {"created_at": time.time(), "jobs": {}}
+                sid,
+                {"created_at": time.time(), "jobs": {},
+                 "priority": int(priority)},
             )
-        self._journal({"op": "create_session", "sid": sid})
+        self._journal(
+            {"op": "create_session", "sid": sid, "priority": int(priority)}
+        )
         return sid
+
+    def session_priority(self, sid: str) -> int:
+        with self._lock:
+            sess = self._sessions.get(sid) or {}
+            return int(sess.get("priority", 0) or 0)
 
     def has_session(self, sid: str) -> bool:
         with self._lock:
@@ -382,6 +401,10 @@ class JobStore:
             pruned = job.get("pruned_subtasks", 0)
             done = job["completed_subtasks"] + job["failed_subtasks"] + pruned
             out = {
+                # the CANONICAL (shard-stamped) id rides every progress/SSE
+                # event, so a client that submitted under a client-minted
+                # id learns the routable id from the stream itself
+                "job_id": job.get("job_id", job_id),
                 "job_status": job["status"],
                 "tasks_completed": done,
                 "tasks_pending": job["total_subtasks"] - done,
@@ -499,7 +522,10 @@ class JobStore:
         try:
             if op == "create_session":
                 self._sessions.setdefault(
-                    e["sid"], {"created_at": time.time(), "jobs": {}}
+                    e["sid"],
+                    {"created_at": time.time(), "jobs": {},
+                     # pre-QoS journals have no priority field: lane 0
+                     "priority": int(e.get("priority", 0) or 0)},
                 )
             elif op == "create_job":
                 self._sessions.setdefault(

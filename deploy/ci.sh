@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf]   (default: fast)
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest]   (default: fast)
 #
 #   fast mode:
 #   1. compileall lint gate — every .py in the package, tests, and
@@ -12,7 +12,16 @@
 #      (tests/test_stage_cache.py: single-flight staging, refcount/LRU
 #      eviction, fingerprint collision safety, CS230_STAGE_CACHE=0
 #      parity; tests/test_prewarm.py: hint derivation, yield-to-work,
-#      never-warm-twice, /subscribe handshake).
+#      never-warm-twice, /subscribe handshake);
+#   3. sharded control-plane smoke — 2 coordinator-shard subprocesses
+#      behind a stateless front end (runtime/frontend.py), reduced client
+#      count, asserting completion + routing (no absolute-latency gate),
+#      so the front/core split topology is exercised on every run.
+#
+#   loadtest mode (nightly/dispatch in ci.yml): the FULL 4-shard
+#   control-plane load test (benchmarks/loadtest.py, ROADMAP item 2
+#   harness) with the functional smoke gate; the fresh
+#   loadtest_4shard.json is uploaded as a workflow artifact.
 #
 #   kernels mode: the interpret-mode kernel-parity suites ONLY — every
 #   Pallas kernel (packed/masked logreg gradients, the fused packed
@@ -155,6 +164,24 @@ elif [ "$MODE" = "chaos" ]; then
     echo "staging_concurrency FAILED (see bench-artifacts/staging_concurrency.log)"
     rc=1
   fi
+elif [ "$MODE" = "loadtest" ]; then
+  # full sharded control-plane load test (nightly/dispatch in ci.yml):
+  # 4 shard subprocesses behind 2 front ends, the ROADMAP item 2
+  # acceptance harness. Measures only — the committed acceptance artifact
+  # (benchmarks/loadtest_4shard.json) is produced on the dev box; this
+  # job uploads the fresh run for trend-watching, with the functional
+  # smoke assertions (completion + routing) as the only gate.
+  echo "== 4-shard control-plane load test (no latency gate) =="
+  mkdir -p bench-artifacts
+  if LOADTEST_SHARDS=4 LOADTEST_FRONTENDS=2 \
+      LOADTEST_OUT=bench-artifacts/loadtest_4shard.json \
+      JAX_PLATFORMS=cpu python benchmarks/loadtest.py --smoke \
+      > bench-artifacts/loadtest_4shard.log 2>&1; then
+    tail -n 2 bench-artifacts/loadtest_4shard.log
+  else
+    echo "loadtest FAILED (see bench-artifacts/loadtest_4shard.log)"
+    rc=1
+  fi
 else
   echo "== tier-1 fast suite (JAX_PLATFORMS=cpu, -m 'not slow') =="
   CS230_JOURNAL_DIR="$ART_DIR/journal" \
@@ -162,6 +189,21 @@ else
   CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  # sharded-topology smoke: 2 shard subprocesses + 1 front end, reduced
+  # client count, completion + routing asserted, NO latency gate — the
+  # front/core split is exercised on every CI run, not just nightly
+  echo "== sharded control-plane smoke (2 shards, 16 clients) =="
+  if LOADTEST_SHARDS=2 LOADTEST_FRONTENDS=1 LOADTEST_CLIENTS=16 \
+      LOADTEST_JOBS_PER_CLIENT=1 LOADTEST_EXECUTORS=1 \
+      LOADTEST_OUT="$ART_DIR/loadtest_smoke.json" \
+      JAX_PLATFORMS=cpu python benchmarks/loadtest.py --smoke \
+      > "$ART_DIR/loadtest_smoke.log" 2>&1; then
+    tail -n 1 "$ART_DIR/loadtest_smoke.log"
+  else
+    echo "sharded smoke FAILED (see $ART_DIR/loadtest_smoke.log)"
+    tail -n 20 "$ART_DIR/loadtest_smoke.log"
+    rc=1
+  fi
 fi
 
 if [ "$rc" -eq 0 ]; then

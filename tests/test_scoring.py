@@ -132,6 +132,35 @@ def test_log_loss_matches_sklearn():
     assert abs(ours - ref) < 1e-5, (ours, ref)
 
 
+def test_log_loss_saturated_probabilities_match_sklearn():
+    """ADVICE r5 #4 pin: sklearn >= 1.5 clips to the input dtype's eps and
+    does NOT renormalize, so exact-0/exact-1 probability rows (a converged
+    solver's one-hot softmax) contribute -log(eps) — the clip-then-
+    renormalize order diverged by O(eps) exactly there. Same f32 input to
+    both sides; parity must hold at the saturated rows too."""
+    from sklearn.metrics import log_loss
+
+    y = np.array([0, 1, 0, 1, 2])
+    p = np.array(
+        [
+            [1.0, 0.0, 0.0],   # saturated, correct
+            [1.0, 0.0, 0.0],   # saturated, maximally wrong: -log(eps)
+            [0.5, 0.25, 0.25],
+            [0.0, 1.0, 0.0],
+            [0.2, 0.3, 0.5],
+        ],
+        dtype=np.float32,
+    )
+    w = np.ones(len(y), dtype=np.float32)
+    ours = -float(M.proba_score(
+        "neg_log_loss", jnp.asarray(y), jnp.asarray(p), jnp.asarray(w), 3))
+    ref = log_loss(y, p, labels=[0, 1, 2])
+    # the wrong saturated row dominates (-log(f32 eps) ~ 15.9): require
+    # parity at a tolerance far below eps-order divergence
+    assert ours > 3.0  # the saturated penalty actually registered
+    assert abs(ours - ref) / ref < 1e-6, (ours, ref)
+
+
 def test_average_precision_matches_sklearn_including_ties():
     from sklearn.metrics import average_precision_score
 
