@@ -609,6 +609,24 @@ def cash_in(
                        "≥40% MFU vs 34.7% standing since r4"
         }
 
+    if backend == "tpu":
+        # the multi-device scaling curve over the REAL chips (ROADMAP
+        # item 4): trials/s at 1..n_devices powers of two with the
+        # efficiency-vs-ideal column, through the mesh-sharded engine +
+        # mesh-aware stage cache
+        sections["multichip_scaling"] = _run_sub(
+            [py, "benchmarks/multichip_bench.py", "--native"], 3600,
+            artifact="benchmarks/MULTICHIP_BENCH_r01.json",
+        )
+    else:
+        sections["multichip_scaling"] = {
+            "skipped": f"requires TPU (backend={backend}); the CPU "
+                       "forced-host-device curve is committed in "
+                       "benchmarks/MULTICHIP_BENCH_r01.json — on a chip "
+                       "this section re-measures over real devices via "
+                       "multichip_bench.py --native",
+        }
+
     sections["cold_profile"] = _run_sub(
         [py, "benchmarks/cold_profile.py", "--measure"], 1200,
         artifact="benchmarks/COLD_PROFILE_MEASURED.json",

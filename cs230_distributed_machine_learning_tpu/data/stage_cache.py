@@ -25,6 +25,19 @@ This module is the process-global replacement:
   ONE upload — later arrivals wait on the maker's event and reuse its
   entry. ``stats()["uploads"]`` is the observable the concurrency
   benchmark (benchmarks/staging_concurrency.py) and its fast test pin.
+- **mesh-shaped entries** (the elastic trial fabric,
+  docs/ARCHITECTURE.md "Elastic trial fabric"): a multi-device mesh job
+  stages the dataset through the slow host->device tunnel ONCE per
+  (dataset, host) — the plain single-device entry, shared with
+  single-device jobs — and then builds its mesh-placed form (trial-axis
+  replicated or data-axis row-sharded) with an on-device
+  ``jax.device_put`` broadcast/reshard that moves bytes over ICI, never
+  back through the tunnel. Mesh entries carry the mesh axis spec in
+  their subkey so the 1-D replicated and 2-D sharded forms coexist;
+  they are cached with ``transport="ici"``, which counts
+  ``replications``/``ici_bytes`` instead of tunnel ``uploads`` —
+  ``uploads_by_key()`` therefore keeps meaning *tunnel* uploads, the
+  <=1-per-(dataset, host) observable the mesh tests pin.
 - **refcounted LRU under a device-memory budget**: runs pin the entries
   they touch (``pin_begin``/``pin_end``, wired through
   ``trial_map.run_trials``); eviction walks LRU order, skips pinned
@@ -120,6 +133,19 @@ def dataset_fingerprint(data) -> str:
     return fp
 
 
+def host_signature() -> tuple:
+    """Host identity for mesh-shaped cache keys: the "once per host" half
+    of the mesh staging contract. Keyed by (platform, process index) —
+    every process of a multi-host SPMD slice stages its own local copy,
+    but all devices OF one host share it."""
+    try:
+        import jax
+
+        return (str(jax.devices()[0].platform), int(jax.process_index()))
+    except Exception:  # noqa: BLE001 — no backend yet
+        return ("none", 0)
+
+
 def _tree_nbytes(value: Any) -> int:
     import jax
 
@@ -164,6 +190,15 @@ class StagedDatasetCache:
             "uploads": 0,
             "evictions": 0,
             "unevictable_overflows": 0,
+            # ---- mesh fabric accounting (transport="ici" entries) ----
+            #: on-device broadcast/reshard builds of mesh-shaped entries
+            "replications": 0,
+            #: bytes that crossed the slow host->device tunnel (misses of
+            #: transport="tunnel" entries)
+            "tunnel_bytes": 0,
+            #: bytes moved device-to-device (ICI on TPU meshes) building
+            #: mesh-shaped entries
+            "ici_bytes": 0,
         }
         #: per-key upload counts — the concurrency benchmark's observable
         self._uploads_by_key: collections.Counter = collections.Counter()
@@ -208,14 +243,25 @@ class StagedDatasetCache:
     # ---------------- lookup / staging ----------------
 
     def get_or_stage(
-        self, key: Any, make: Callable[[], Any]
+        self, key: Any, make: Callable[[], Any], *,
+        transport: str = "tunnel", ici_bytes: Optional[int] = None,
     ) -> Tuple[Any, str]:
         """Return ``(value, outcome)`` where outcome is ``"hit"`` (cached),
         ``"wait"`` (another thread staged it while we waited — no upload
         paid by THIS caller beyond the wait), or ``"miss"`` (this caller
         performed the upload). Exactly one concurrent caller per key runs
         ``make()``; a failed make releases the waiters to retry (the next
-        one becomes the maker)."""
+        one becomes the maker).
+
+        ``transport`` attributes the miss's bytes: ``"tunnel"`` (default)
+        is a host->device staging upload and counts toward ``uploads`` /
+        ``tunnel_bytes``; ``"ici"`` is an on-device broadcast/reshard of
+        an already-resident tensor (mesh-shaped entries) and counts
+        toward ``replications`` / ``ici_bytes`` instead — *never* toward
+        the tunnel upload counters the <=1-per-(dataset, host) contract
+        is asserted on. ``ici_bytes`` overrides the traffic estimate for
+        an ici miss (e.g. nbytes x (n_devices - 1) for a full replicate);
+        default is the made value's footprint."""
         waited = False
         while True:
             with self._lock:
@@ -245,14 +291,21 @@ class StagedDatasetCache:
             raise
         wall_s = time.perf_counter() - t0
         nbytes = _tree_nbytes(value)
+        ici = transport == "ici"
+        moved = int(ici_bytes) if (ici and ici_bytes is not None) else nbytes
         evicted: List[Tuple[Any, int]] = []
         with self._lock:
             self._entries[key] = _Entry(value, nbytes)
             self._entries.move_to_end(key)
             self._bytes += nbytes
             self._stats["misses"] += 1
-            self._stats["uploads"] += 1
-            self._uploads_by_key[key] += 1
+            if ici:
+                self._stats["replications"] += 1
+                self._stats["ici_bytes"] += moved
+            else:
+                self._stats["uploads"] += 1
+                self._stats["tunnel_bytes"] += nbytes
+                self._uploads_by_key[key] += 1
             self._pin_locked(key)
             evicted = self._evict_over_budget_locked(exclude=key)
             total_bytes, n_entries = self._bytes, len(self._entries)
@@ -261,13 +314,19 @@ class StagedDatasetCache:
             self._inflight.pop(key, None)
         ev.set()
         counter_inc("tpuml_stage_cache_misses_total")
-        counter_inc("tpuml_stage_cache_uploads_total")
+        if ici:
+            counter_inc("tpuml_stage_cache_replications_total")
+            counter_inc("tpuml_stage_cache_ici_bytes_total", float(moved))
+        else:
+            counter_inc("tpuml_stage_cache_uploads_total")
+            counter_inc("tpuml_stage_cache_tunnel_bytes_total", float(nbytes))
         gauge_set("tpuml_stage_cache_bytes", float(total_bytes))
         gauge_set("tpuml_stage_cache_entries", float(n_entries))
         record_event(
-            "stage.upload",
+            "stage.replicate" if ici else "stage.upload",
             key=repr(key), nbytes=nbytes, wall_s=round(wall_s, 6),
             cache_bytes=total_bytes, cache_entries=n_entries,
+            **({"ici_bytes": moved} if ici else {}),
         )
         for ekey, enbytes in evicted:
             counter_inc("tpuml_stage_cache_evictions_total")

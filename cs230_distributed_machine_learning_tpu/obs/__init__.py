@@ -297,6 +297,39 @@ def register_catalog() -> None:
         "tpuml_stage_cache_entries",
         "Entries resident in the staged-dataset cache",
     )
+    # ---- elastic trial fabric (docs/ARCHITECTURE.md "Elastic trial
+    # fabric") ----
+    c(
+        "tpuml_stage_cache_replications_total",
+        "Mesh-shaped cache entries built by on-device broadcast/reshard "
+        "(ICI) from an already-resident host copy — never a tunnel upload",
+    )
+    c(
+        "tpuml_stage_cache_tunnel_bytes_total",
+        "Bytes staged over the slow host->device tunnel (cache misses of "
+        "tunnel-transport entries)",
+    )
+    c(
+        "tpuml_stage_cache_ici_bytes_total",
+        "Bytes moved device-to-device (ICI on TPU meshes) building "
+        "mesh-shaped staged entries",
+    )
+    c(
+        "tpuml_mesh_reshards_total",
+        "Fleet mesh-generation bumps, labeled by reason "
+        "(join|death|evict|unsubscribe)",
+    )
+    g(
+        "tpuml_mesh_generation",
+        "Current fleet mesh generation (bumped on every worker "
+        "join/death/eviction; journal-replayed across coordinator "
+        "restarts)",
+    )
+    g(
+        "tpuml_mesh_devices_total",
+        "Devices across every live worker's mesh slice (the data-plane "
+        "width placements pack onto)",
+    )
     # ---- background AOT prewarm (docs/OBSERVABILITY.md "Data-plane
     # caching") ----
     c(

@@ -41,6 +41,24 @@ def trial_mesh(
     return Mesh(arr, (trial_axis, data_axis))
 
 
+def mesh_info(mesh) -> tuple:
+    """(n_devices, {axis: size}) of a worker's mesh slice — the report the
+    placement engine's predictor-aware packing prices placements by
+    (docs/ARCHITECTURE.md "Elastic trial fabric"). Shared by the local
+    (cluster.add_executor) and remote (WorkerAgent /subscribe)
+    registration paths so both report identically. No mesh = one device."""
+    if mesh is None:
+        return 1, None
+    try:
+        shape = {str(k): int(v) for k, v in mesh.shape.items()}
+        n = 1
+        for v in shape.values():
+            n *= v
+        return max(n, 1), shape
+    except Exception:  # noqa: BLE001 — exotic mesh object: one device
+        return 1, None
+
+
 def pad_to_multiple(n: int, multiple: int) -> int:
     if multiple <= 1:
         return n

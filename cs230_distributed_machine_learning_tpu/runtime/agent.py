@@ -114,6 +114,10 @@ class WorkerAgent:
         #: path (runtime/prewarm.py; CS230_PREWARM=0 disables)
         self._prewarm_hints: List[Dict[str, Any]] = []
         self._prewarm = None
+        #: this host's mesh slice: reported at /subscribe so the
+        #: placement engine prices trial batches per slice, and re-used
+        #: on every re-registration
+        self._mesh = mesh
         self.worker_id = self._register(mem_capacity_mb, register_retries, register_backoff_s)
         self.executor = _make_executor(self.url, self.worker_id, mesh, max_batch)
         self._threads: List[threading.Thread] = []
@@ -126,6 +130,21 @@ class WorkerAgent:
 
     # ---------------- lifecycle ----------------
 
+    def _mesh_report(self) -> Dict[str, Any]:
+        """The /subscribe mesh-slice report: how many devices this
+        worker's batches shard across. Only an EXPLICIT mesh widens the
+        report — a meshless agent's executor dispatches single-device, so
+        pricing it wider would mispack it. Shares mesh_info with the
+        in-process registration path (cluster.add_executor) so local and
+        remote workers report identically."""
+        from ..parallel.mesh import mesh_info
+
+        n_devices, mesh_shape = mesh_info(self._mesh)
+        report: Dict[str, Any] = {"n_devices": n_devices}
+        if mesh_shape is not None:
+            report["mesh_shape"] = mesh_shape
+        return report
+
     def _register(self, mem_capacity_mb, retries: int, backoff_s: float) -> str:
         import requests
 
@@ -134,7 +153,8 @@ class WorkerAgent:
             try:
                 resp = requests.post(
                     f"{self.url}/subscribe",
-                    json={"mem_capacity_mb": mem_capacity_mb},
+                    json={"mem_capacity_mb": mem_capacity_mb,
+                          **self._mesh_report()},
                     timeout=10,
                 )
                 resp.raise_for_status()

@@ -194,7 +194,15 @@ class ClusterRuntime:
     def add_executor(
         self, mesh=None, mem_capacity_mb: Optional[float] = None, executor: Optional[LocalExecutor] = None
     ) -> str:
-        wid = self.engine.subscribe(mem_capacity_mb=mem_capacity_mb)
+        from ..parallel.mesh import mesh_info
+
+        if mesh is None and executor is not None:
+            mesh = executor.mesh
+        n_devices, mesh_shape = mesh_info(mesh)
+        wid = self.engine.subscribe(
+            mem_capacity_mb=mem_capacity_mb,
+            n_devices=n_devices, mesh_shape=mesh_shape,
+        )
         executor = executor or LocalExecutor(executor_id=wid, mesh=mesh, cache=self.cache)
         executor.executor_id = wid
         worker = ExecutorWorker(self, executor, wid)
@@ -306,8 +314,16 @@ class ClusterRuntime:
     # reference worker's /subscribe + keyed Kafka consumption
     # (worker.py:90-112, 185-186).
 
-    def register_remote(self, mem_capacity_mb: Optional[float] = None) -> str:
-        wid = self.engine.subscribe(mem_capacity_mb=mem_capacity_mb)
+    def register_remote(
+        self,
+        mem_capacity_mb: Optional[float] = None,
+        n_devices: Optional[int] = None,
+        mesh_shape: Optional[Dict[str, int]] = None,
+    ) -> str:
+        wid = self.engine.subscribe(
+            mem_capacity_mb=mem_capacity_mb,
+            n_devices=n_devices, mesh_shape=mesh_shape,
+        )
         self._remote_subs[wid] = self.bus.subscribe(
             TOPIC_TRAIN, key_filter=lambda k, w=wid: k == w, priority=True
         )

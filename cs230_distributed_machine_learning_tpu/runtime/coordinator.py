@@ -115,6 +115,10 @@ class Coordinator:
             # ones (docs/ROBUSTNESS.md "Coordinator recovery")
             cluster.ledger.on_attempt = self._journal_attempt
             cluster.engine.on_place = self._journal_placement
+            # journal mesh-generation bumps (worker join/death/evict) so
+            # a recovered coordinator replays the fleet's reshard history
+            # (docs/ARCHITECTURE.md "Elastic trial fabric")
+            cluster.engine.on_mesh_change = self._journal_mesh_change
             # overload probe: speculation sheds first under load
             cluster.engine.shed_check = self.overload_shedding
         if journal:
@@ -134,6 +138,24 @@ class Coordinator:
             replay_skipped=self.store.replay_skipped,
             replay_seconds=round(self.store.replay_seconds, 6),
         )
+        if self.cluster is not None and self.store.mesh_generation:
+            # resume the reshard counter monotonically: workers that
+            # registered before recovery finished already bumped the live
+            # engine, so take the max of both histories — and refresh the
+            # gauges, which otherwise keep the pre-recovery value until
+            # the next live reshard
+            eng = self.cluster.engine
+            with eng._lock:  # merge under the bump lock: a concurrent
+                # join's increment must not be overwritten
+                eng.mesh_generation = max(
+                    eng.mesh_generation, self.store.mesh_generation
+                )
+                gauge_set(
+                    "tpuml_mesh_generation", float(eng.mesh_generation)
+                )
+                gauge_set(
+                    "tpuml_mesh_devices_total", float(eng.total_devices())
+                )
         resumed = self.resume_inflight()
         recovery_s = self.store.replay_seconds + (time.time() - t0)
         self.recovery = {
@@ -171,6 +193,14 @@ class Coordinator:
             # a job this store never saw (foreign traffic on a shared
             # cluster): nothing to journal
             pass
+
+    def _journal_mesh_change(
+        self, generation: int, reason: str, snapshot: Dict[str, Any]
+    ) -> None:
+        try:
+            self.store.record_mesh_generation(generation, reason)
+        except Exception:  # noqa: BLE001 — journaling must not block resharding
+            logger.exception("Mesh-generation journal failed")
 
     def _journal_placement(self, task: Dict[str, Any], worker_id: str,
                            lease_deadline=None) -> None:

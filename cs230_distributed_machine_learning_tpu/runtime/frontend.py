@@ -65,6 +65,30 @@ _FWD_HEADERS = (
 )
 
 
+#: request bodies at or above this size are streamed to the owning shard
+#: chunk-wise instead of being buffered whole in the front end (zero-copy
+#: proxying for large dataset uploads; small bodies keep the simple path)
+_STREAM_BODY_MIN = 256 * 1024
+
+
+class _BodyStream:
+    """File-like view over the WSGI input stream with a declared length.
+
+    ``requests``/urllib3 stream a ``read()``-able body upstream in fixed
+    chunks, and the ``len`` attribute makes them send a Content-Length
+    header instead of chunked transfer-encoding (which the shards' dev
+    server would reject) — so a large ``/train`` or ``/download_data``
+    body crosses the front end hop-by-hop in 8 KB chunks, never fully
+    resident, instead of being re-read into memory per hop."""
+
+    def __init__(self, stream, length: int):
+        self._stream = stream
+        self.len = int(length)
+
+    def read(self, n: int = -1) -> bytes:
+        return self._stream.read(n)
+
+
 def _inject_shard_label(body: str, shard: int) -> List[str]:
     """Rewrite one shard's Prometheus exposition so every series carries
     a ``shard=<k>`` label — the merge that keeps identical series from N
@@ -139,11 +163,24 @@ def create_frontend_app(shard_urls: List[str]):
             v = request.headers.get(h)
             if v:
                 headers[h] = v
+        if body is not None:
+            data = body
+        else:
+            cl = request.content_length
+            if (
+                cl and cl >= _STREAM_BODY_MIN
+                and request.method in ("POST", "PUT")
+            ):
+                # zero-copy: relay the body chunk-wise from the client
+                # socket to the shard socket (see _BodyStream)
+                data = _BodyStream(request.stream, cl)
+            else:
+                data = request.get_data()
         return session.request(
             request.method,
             f"{urls[k]}{path}",
             params=request.query_string.decode() or None,
-            data=request.get_data() if body is None else body,
+            data=data,
             headers=headers,
             stream=stream,
             timeout=timeout,

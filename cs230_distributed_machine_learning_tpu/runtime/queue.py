@@ -13,9 +13,11 @@ implementation plugs into for multi-host (runtime/agent.py).
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 
@@ -29,6 +31,7 @@ class TopicBus:
         topic: str,
         key_filter: Optional[Callable[[Any], bool]] = None,
         priority: bool = False,
+        aging_s: Optional[float] = None,
     ) -> "Subscription":
         """``priority=True`` makes this subscription a QoS lane consumer
         (docs/ARCHITECTURE.md "QoS priority lanes"): delivery order is by
@@ -36,8 +39,17 @@ class TopicBus:
         only, default lane 0), FIFO within a lane. The dispatch-side
         subscriptions (task ingress, per-worker train queues) opt in so a
         heavy tenant's backlog cannot starve a higher-priority session;
-        result/metrics subscriptions stay plain FIFO."""
-        sub = Subscription(self, topic, key_filter, priority=priority)
+        result/metrics subscriptions stay plain FIFO.
+
+        Strict priority alone starves: under a sustained high-lane flood
+        a lane-0 message would wait forever. Priority subscriptions
+        therefore age — a waiting message is promoted one lane per
+        ``aging_s`` seconds of queue age (default: the ``qos_aging_s``
+        scheduler config knob; <= 0 restores pure strict priority), so
+        bounded starvation is the contract, not unbounded."""
+        sub = Subscription(
+            self, topic, key_filter, priority=priority, aging_s=aging_s
+        )
         with self._lock:
             self._subs.setdefault(topic, []).append(sub)
         return sub
@@ -78,12 +90,20 @@ class TopicBus:
 
 class Subscription:
     def __init__(
-        self, bus: TopicBus, topic: str, key_filter, priority: bool = False
+        self, bus: TopicBus, topic: str, key_filter,
+        priority: bool = False, aging_s: Optional[float] = None,
     ) -> None:
         self._bus = bus
         self.topic = topic
         self.key_filter = key_filter
         self._priority = priority
+        if priority and aging_s is None:
+            from ..utils.config import get_config
+
+            aging_s = get_config().scheduler.qos_aging_s
+        self._aging_s = float(aging_s or 0.0)
+        #: throttle stamp for the lazy promotion sweep
+        self._last_promote = 0.0
         #: tie-break sequence: FIFO within a priority lane (PriorityQueue
         #: would otherwise compare the message dicts and raise)
         self._seq = itertools.count()
@@ -102,19 +122,48 @@ class Subscription:
 
     def _put(self, key: Any, message: Any) -> None:
         if self._priority:
+            prio = self._message_priority(message)
+            # entry: (-effective_lane, seq, enqueue_ts, base_lane, key,
+            # message) — the consumer-facing get()s slice the last two
             self._q.put(
-                (-self._message_priority(message), next(self._seq),
-                 key, message)
+                (-prio, next(self._seq), time.time(), prio, key, message)
             )
         else:
             self._q.put((key, message))
 
+    def _promote_aged(self) -> None:
+        """QoS lane aging: raise a waiting entry's effective lane by one
+        per ``aging_s`` seconds of queue age, so a sustained high-lane
+        flood cannot starve low lanes forever (bounded starvation:
+        worst-case wait ~= lane_gap x aging_s). Runs lazily at consume
+        time, throttled — order only matters when entries are waiting,
+        and every get() re-checks."""
+        if not self._priority or self._aging_s <= 0:
+            return
+        now = time.time()
+        if now - self._last_promote < min(1.0, self._aging_s / 4):
+            return
+        self._last_promote = now
+        q = self._q
+        with q.mutex:
+            heap = q.queue
+            changed = False
+            for i, (neg_lane, seq, ts, base, key, msg) in enumerate(heap):
+                eff = base + int((now - ts) // self._aging_s)
+                if eff > -neg_lane:
+                    heap[i] = (-eff, seq, ts, base, key, msg)
+                    changed = True
+            if changed:
+                heapq.heapify(heap)
+
     def get(self, timeout: Optional[float] = None):
         """Returns (key, message); raises queue.Empty on timeout."""
+        self._promote_aged()
         item = self._q.get(timeout=timeout)
         return item[-2:] if self._priority else item
 
     def get_nowait(self):
+        self._promote_aged()
         item = self._q.get_nowait()
         return item[-2:] if self._priority else item
 

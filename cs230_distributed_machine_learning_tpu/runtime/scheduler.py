@@ -77,6 +77,13 @@ TOPIC_RESULT = "result"
 class WorkerState:
     worker_id: str
     mem_capacity_mb: float
+    #: devices in this worker's mesh slice (reported at /subscribe) — the
+    #: predictor-aware packing divisor: a trial batch parallelizes across
+    #: the slice, so an N-device worker drains its queue ~N x faster and
+    #: its placement score prices estimates per slice, not per process
+    n_devices: int = 1
+    #: mesh axis spec of the slice ({axis: size}), advisory/observability
+    mesh_shape: Optional[Dict[str, int]] = None
     load_seconds: float = 0.0
     mem_load_mb: float = 0.0
     speed_factor: float = 1.0
@@ -110,6 +117,13 @@ class WorkerState:
 
     def effective_finish_time(self) -> float:
         return self.load_seconds / max(self.speed_factor, 1e-3)
+
+    def slice_est(self, est: float) -> float:
+        """Price an estimate per mesh slice: the trial engine shards a
+        batch's trial axis across the worker's devices, so wall time
+        divides by the slice width (the speed_factor EWMA then corrects
+        whatever the ideal-scaling assumption gets wrong)."""
+        return est / max(int(self.n_devices or 1), 1)
 
     def n_outcomes(self) -> int:
         return self.n_completed + self.n_failed
@@ -153,6 +167,18 @@ class PlacementEngine:
         #: True while the fleet is shedding optional work — speculation
         #: skips its launches first, before admission starts rejecting
         self.shed_check: Optional[Callable[[], bool]] = None
+        #: elastic-fabric mesh generation (docs/ARCHITECTURE.md "Elastic
+        #: trial fabric"): bumped whenever the fleet's device topology
+        #: changes (worker join / death / eviction / unsubscribe). Every
+        #: placement stamps the task with the current generation; the
+        #: coordinator journals bumps (``on_mesh_change``) so recovery
+        #: replays the generation instead of restarting at 0.
+        self.mesh_generation = 0
+        #: called with (generation, reason, snapshot) after each bump —
+        #: the coordinator hooks this to journal the reshard
+        self.on_mesh_change: Optional[
+            Callable[[int, str, Dict[str, Any]], None]
+        ] = None
         self._lock = threading.RLock()
         self.workers: Dict[str, WorkerState] = {}
         self._next_id = 0
@@ -163,7 +189,13 @@ class PlacementEngine:
 
     # ---------------- registry (subscribe/heartbeat/unsubscribe) ----------------
 
-    def subscribe(self, mem_capacity_mb: Optional[float] = None, worker_id: Optional[str] = None) -> str:
+    def subscribe(
+        self,
+        mem_capacity_mb: Optional[float] = None,
+        worker_id: Optional[str] = None,
+        n_devices: Optional[int] = None,
+        mesh_shape: Optional[Dict[str, int]] = None,
+    ) -> str:
         with self._lock:
             if worker_id is None:
                 worker_id = f"{self.worker_prefix}worker-{self._next_id}"
@@ -171,10 +203,19 @@ class PlacementEngine:
             self.workers[worker_id] = WorkerState(
                 worker_id=worker_id,
                 mem_capacity_mb=mem_capacity_mb or self.cfg.default_mem_capacity_mb,
+                n_devices=max(int(n_devices or 1), 1),
+                mesh_shape=(
+                    {str(k): int(v) for k, v in mesh_shape.items()}
+                    if mesh_shape else None
+                ),
             )
-            logger.info("Worker %s subscribed", worker_id)
+            logger.info(
+                "Worker %s subscribed (%d-device slice)",
+                worker_id, self.workers[worker_id].n_devices,
+            )
             gauge_set("tpuml_workers_alive", len(self.workers))
-            return worker_id
+        self._mesh_changed("join", worker_id)
+        return worker_id
 
     def unsubscribe(self, worker_id: str) -> List[Dict[str, Any]]:
         """Remove a worker; requeue its queued tasks. Returns the requeued tasks."""
@@ -185,7 +226,54 @@ class PlacementEngine:
         if state is None:
             return []
         logger.info("Worker %s unsubscribed; requeueing %d tasks", worker_id, len(state.tasks_queue))
+        self._mesh_changed("unsubscribe", worker_id)
         return self._requeue(state.tasks_queue, from_worker=worker_id)
+
+    # ---------------- elastic mesh fabric ----------------
+
+    def total_devices(self) -> int:
+        """Devices across every live worker's mesh slice — the fleet's
+        current data-plane width."""
+        with self._lock:
+            return sum(
+                max(int(w.n_devices or 1), 1) for w in self.workers.values()
+            )
+
+    def _mesh_changed(self, reason: str, worker_id: str) -> None:
+        """The fleet's device topology changed: bump the mesh generation,
+        record the reshard, and notify the journal hook. In-flight work
+        placed under the old generation is re-placed by the existing
+        lease/requeue machinery with fresh attempt ids — a killed host's
+        trials resume on the reshaped fleet without manual restart
+        (docs/ARCHITECTURE.md "Elastic trial fabric")."""
+        # bump AND emit under one lock hold: two concurrent topology
+        # changes must publish their gauges/events/journal entries in
+        # generation order, or the gauge could regress to the earlier
+        # generation and the event stream would read out of order. The
+        # emission targets (registry, recorder, store journal) never
+        # call back into this engine, so no lock-ordering hazard.
+        with self._lock:
+            self.mesh_generation += 1
+            gen = self.mesh_generation
+            snapshot = {
+                "n_workers": len(self.workers),
+                "total_devices": self.total_devices(),
+            }
+            gauge_set("tpuml_mesh_generation", float(gen))
+            gauge_set(
+                "tpuml_mesh_devices_total", float(snapshot["total_devices"])
+            )
+            counter_inc("tpuml_mesh_reshards_total", reason=reason)
+            record_event(
+                "mesh.reshard", generation=gen, reason=reason,
+                worker_id=worker_id, **snapshot,
+            )
+            hook = self.on_mesh_change
+            if hook is not None:
+                try:
+                    hook(gen, reason, snapshot)
+                except Exception:  # noqa: BLE001 — journaling must not block
+                    logger.exception("Mesh-change journal hook failed")
 
     def heartbeat(self, worker_id: str) -> bool:
         with self._lock:
@@ -205,6 +293,8 @@ class PlacementEngine:
                     "speed_factor": w.speed_factor,
                     "last_heartbeat": w.last_heartbeat,
                     "queue_depth": len(w.tasks_queue),
+                    "n_devices": w.n_devices,
+                    "mesh_shape": w.mesh_shape,
                 }
                 for wid, w in self.workers.items()
             }
@@ -349,6 +439,7 @@ class PlacementEngine:
             breaker_trips=state.breaker_trips,
         )
         self._drop_worker_gauges(worker_id)
+        self._mesh_changed("evict", worker_id)
         hook = self.on_evict
         if hook is not None:
             try:
@@ -403,6 +494,7 @@ class PlacementEngine:
                 "straggler": wid in stragglers,
                 "breaker_state": w.breaker_state,
                 "breaker_trips": w.breaker_trips,
+                "n_devices": w.n_devices,
             }
             for wid, w in self.workers.items()
         }
@@ -551,9 +643,13 @@ class PlacementEngine:
             penalty = self.cfg.straggler_penalty_s
 
             def _score(w: WorkerState) -> float:
+                # predictor-aware mesh packing: the estimate is priced per
+                # mesh slice (est / n_devices) so a wide slice absorbs the
+                # expensive wide-W trials while cheap trials keep landing
+                # on narrow workers instead of serializing behind them
                 return (
                     w.effective_finish_time()
-                    + est / max(w.speed_factor, 1e-3)
+                    + w.slice_est(est) / max(w.speed_factor, 1e-3)
                     + (penalty if w.worker_id in stragglers else 0.0)
                 )
 
@@ -577,6 +673,15 @@ class PlacementEngine:
                         if w.worker_id in stragglers
                     ),
                     "chosen_score": _score(best),
+                    # the packing decision's mesh context (docs/
+                    # ARCHITECTURE.md "Elastic trial fabric"): the chosen
+                    # worker's slice shape and the fleet generation the
+                    # placement happened under
+                    "mesh_slice": {
+                        "n_devices": best.n_devices,
+                        "mesh_shape": best.mesh_shape,
+                        "generation": self.mesh_generation,
+                    },
                     "candidates": [
                         {
                             "worker_id": w.worker_id,
@@ -584,8 +689,9 @@ class PlacementEngine:
                             "effective_finish_time_s":
                                 w.effective_finish_time(),
                             "est_over_speed_s":
-                                est / max(w.speed_factor, 1e-3),
+                                w.slice_est(est) / max(w.speed_factor, 1e-3),
                             "speed_factor": w.speed_factor,
+                            "n_devices": w.n_devices,
                             "load_seconds": w.load_seconds,
                             "mem_load_mb": w.mem_load_mb,
                             "queue_depth": len(w.tasks_queue),
@@ -596,11 +702,21 @@ class PlacementEngine:
                         for w in ranked
                     ],
                 }
+            # books absorb the SLICE-priced estimate: the same figure
+            # on_metrics pops back out and the lease/calibration paths
+            # consume — the predictor is measured against the estimate
+            # that actually drove the decision
+            est = best.slice_est(est)
             best.load_seconds += est
             best.mem_load_mb += mem_mb
             best.tasks_queue.append(task)
             best.task_est[stid] = est
             best.task_mem[stid] = mem_mb
+            # stamp the fleet generation the placement happened under —
+            # a reshard (join/death/evict) bumps it, and re-placements of
+            # reclaimed work carry the new generation with their fresh
+            # attempt id
+            task["mesh_generation"] = self.mesh_generation
             now = time.time()
             best.task_placed_at[stid] = now
             lease_deadline = None
@@ -683,6 +799,7 @@ class PlacementEngine:
             w = self.workers.get(wid)
             if w is None:
                 return
+            n_dev = max(int(w.n_devices or 1), 1)
             est = w.task_est.pop(stid, 0.0)
             mem = w.task_mem.pop(stid, 0.0)
             w.task_lease.pop(stid, None)
@@ -714,7 +831,14 @@ class PlacementEngine:
                 )
                 w.n_batches += 1
         if actual is not None:
-            self.predictor.observe(msg, actual)
+            # the predictor learns DEVICE-NORMALIZED walls: a wall measured
+            # on an N-device slice is already slice-shortened, and place()
+            # divides the estimate by the candidate's slice width — feeding
+            # the raw wall would divide by n_devices twice (estimates and
+            # leases shrinking toward T/N^2 on wide fleets). Calibration
+            # and the speed/health EWMAs below stay per-worker raw: they
+            # measure the AS-USED sliced estimate against this worker.
+            self.predictor.observe(msg, actual * n_dev)
             if est > 0:
                 # calibration telemetry: est is the exact estimate the
                 # placement consumed (algo multiplier included) and the
@@ -848,6 +972,7 @@ class PlacementEngine:
                 n_requeued=len(w.tasks_queue),
             )
             self._drop_worker_gauges(w.worker_id)
+            self._mesh_changed("death", w.worker_id)
             self._requeue(w.tasks_queue, from_worker=w.worker_id)
         self._speculate()
         if dead or reclaimed:

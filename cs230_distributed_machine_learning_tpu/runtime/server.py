@@ -846,8 +846,38 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return coord.cluster
 
     def subscribe(request):
+        from werkzeug.exceptions import BadRequest
+
         body = request.get_json(silent=True) or {}
-        wid = _cluster_or_400().register_remote(body.get("mem_capacity_mb"))
+        # n_devices / mesh_shape: the worker's mesh-slice report — the
+        # placement engine's predictor-aware packing divisor
+        # (docs/ARCHITECTURE.md "Elastic trial fabric"). Validated here:
+        # a malformed report must be an immediate 400 the agent can act
+        # on, not a 500 it burns its whole register-retry budget against.
+        n_devices = body.get("n_devices")
+        if n_devices is not None:
+            try:
+                n_devices = int(n_devices)
+            except (TypeError, ValueError):
+                raise BadRequest(
+                    f"n_devices must be an integer, got {n_devices!r}"
+                )
+        mesh_shape = body.get("mesh_shape")
+        if mesh_shape is not None:
+            try:
+                mesh_shape = {
+                    str(k): int(v) for k, v in mesh_shape.items()
+                }
+            except (TypeError, ValueError, AttributeError):
+                raise BadRequest(
+                    "mesh_shape must be an object of integer axis sizes, "
+                    f"got {mesh_shape!r}"
+                )
+        wid = _cluster_or_400().register_remote(
+            body.get("mem_capacity_mb"),
+            n_devices=n_devices,
+            mesh_shape=mesh_shape,
+        )
         resp = {"worker_id": wid}
         try:
             # predictor-driven AOT prewarm hints (docs/ARCHITECTURE.md

@@ -60,6 +60,11 @@ class JobStore:
         self.replay_ops: Dict[str, int] = {}
         self.replay_skipped = 0
         self.replay_seconds = 0.0
+        #: highest journaled mesh generation (elastic trial fabric,
+        #: docs/ARCHITECTURE.md): replayed at boot so a recovered
+        #: coordinator's placement engine resumes its generation counter
+        #: monotonically instead of restarting at 0
+        self.mesh_generation = 0
         if journal_dir:
             os.makedirs(journal_dir, exist_ok=True)
             self._journal_path = os.path.join(journal_dir, "jobs.jsonl")
@@ -323,6 +328,24 @@ class JobStore:
             }
         )
 
+    def record_mesh_generation(
+        self, generation: int, reason: Optional[str] = None
+    ) -> None:
+        """Journal a mesh-generation bump (worker join/death/evict —
+        the elastic fabric's reshard marker) so recovery replays the
+        fleet topology history instead of resetting the counter."""
+        with self._lock:
+            self.mesh_generation = max(
+                self.mesh_generation, int(generation or 0)
+            )
+        self._journal(
+            {
+                "op": "mesh_gen",
+                "generation": int(generation or 0),
+                "reason": reason,
+            }
+        )
+
     def has_job(self, sid: str, job_id: str) -> bool:
         with self._lock:
             sess = self._sessions.get(sid)
@@ -560,6 +583,13 @@ class JobStore:
                 spec["placed_attempt"] = int(e.get("attempt", 0) or 0)
                 if e.get("lease_deadline") is not None:
                     spec["lease_deadline"] = float(e["lease_deadline"])
+            elif op == "mesh_gen":
+                # elastic-fabric reshard marker: keep the highest seen
+                # (bumps are monotonic; a truncated tail just resumes
+                # from an earlier generation, still monotonic)
+                self.mesh_generation = max(
+                    self.mesh_generation, int(e.get("generation", 0) or 0)
+                )
             elif op == "finalize_job":
                 job = self._sessions[e["sid"]]["jobs"][e["jid"]]
                 job["result"] = e["result"]

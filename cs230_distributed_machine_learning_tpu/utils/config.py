@@ -103,6 +103,12 @@ class SchedulerConfig:
     breaker_failure_ratio: float = 0.5
     breaker_min_outcomes: int = 4
     breaker_max_trips: int = 3
+    # ---- QoS lane aging (docs/ARCHITECTURE.md "QoS priority lanes") ----
+    # strict-priority dispatch queues promote a waiting message one lane
+    # per qos_aging_s seconds of queue age, so a sustained high-priority
+    # flood cannot starve low lanes forever. <= 0 disables (pure strict
+    # priority).
+    qos_aging_s: float = 30.0
 
 
 @dataclasses.dataclass

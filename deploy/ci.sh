@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest]   (default: fast)
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip]   (default: fast)
 #
 #   fast mode:
 #   1. compileall lint gate — every .py in the package, tests, and
@@ -48,6 +48,14 @@
 #   followed by an injected-regression drill (PERF_OBS_INJECT) proving
 #   the gate itself still trips. Fresh measurements always land in
 #   bench-artifacts/PERF_OBSERVATORY.json for upload.
+#
+#   multichip mode: the elastic-trial-fabric gate (docs/ARCHITECTURE.md
+#   "Elastic trial fabric"). The mesh cache-parity + resharding suites
+#   plus the scaling harness at 1/2 forced host devices (quick reps, no
+#   >1.0x gate — the smoke proves the harness end to end; the committed
+#   benchmarks/MULTICHIP_BENCH_r01.json proves the scaling). The nightly
+#   ci.yml job additionally runs the FULL 1/2/4/8 curve and uploads the
+#   fresh MULTICHIP_BENCH JSON for trend-watching.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -163,6 +171,40 @@ elif [ "$MODE" = "chaos" ]; then
   else
     echo "staging_concurrency FAILED (see bench-artifacts/staging_concurrency.log)"
     rc=1
+  fi
+elif [ "$MODE" = "multichip" ]; then
+  echo "== elastic trial fabric: mesh cache parity + resharding suites =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_stage_cache.py tests/test_resharding.py \
+    tests/test_distributed_mesh.py tests/test_2d_mesh.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  echo "== multichip scaling smoke (forced 1/2 host devices, quick) =="
+  mkdir -p bench-artifacts
+  if JAX_PLATFORMS=cpu python benchmarks/multichip_bench.py \
+      --devices 1,2 --quick --no-check \
+      --out bench-artifacts/MULTICHIP_BENCH_smoke.json \
+      > bench-artifacts/multichip_smoke.log 2>&1; then
+    tail -n 2 bench-artifacts/multichip_smoke.log
+  else
+    echo "multichip smoke FAILED (see bench-artifacts/multichip_smoke.log)"
+    tail -n 20 bench-artifacts/multichip_smoke.log
+    rc=1
+  fi
+  if [ "${MULTICHIP_FULL:-0}" = "1" ]; then
+    echo "== FULL multichip scaling curve (1/2/4/8, nightly) =="
+    if JAX_PLATFORMS=cpu python benchmarks/multichip_bench.py \
+        --out bench-artifacts/MULTICHIP_BENCH_nightly.json \
+        > bench-artifacts/multichip_full.log 2>&1; then
+      tail -n 5 bench-artifacts/multichip_full.log
+    else
+      echo "multichip full curve FAILED (see bench-artifacts/multichip_full.log)"
+      tail -n 20 bench-artifacts/multichip_full.log
+      rc=1
+    fi
   fi
 elif [ "$MODE" = "loadtest" ]; then
   # full sharded control-plane load test (nightly/dispatch in ci.yml):

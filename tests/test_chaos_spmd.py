@@ -241,3 +241,182 @@ def test_spmd_host_loss_requeues_onto_survivor(tmp_path):
                 p.kill()
         for f in logs.values():
             f.close()
+
+
+MESH_AGENT_SCRIPT = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+from cs230_distributed_machine_learning_tpu.parallel.mesh import trial_mesh
+from cs230_distributed_machine_learning_tpu.runtime.agent import WorkerAgent
+agent = WorkerAgent(sys.argv[1], mesh=trial_mesh(), poll_timeout_s=0.5,
+                    register_backoff_s=0.5, max_batch=2)
+agent.run_forever()
+"""
+
+
+def test_mesh_host_kill_completes_on_reshaped_fabric(tmp_path):
+    """Elastic-trial-fabric host-loss drill (docs/ARCHITECTURE.md
+    "Elastic trial fabric"): two 4-device mesh hosts serve one job; one
+    host is SIGKILLed mid-job. The engine's mesh generation bumps, the
+    dead host's trials are re-placed on the reshaped fabric with fresh
+    attempt ids (lease + attempt machinery), and the job completes with
+    winner parity vs a clean run — no manual restart anywhere."""
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+
+    env = dict(os.environ)
+    env["TPUML_STORAGE__ROOT"] = str(tmp_path / "tpuml")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env["TPUML_PLATFORM"] = "cpu"
+    env["TPUML_SCHEDULER__HEARTBEAT_INTERVAL_S"] = "1.0"
+    env["TPUML_SCHEDULER__DEAD_AFTER_S"] = "3.0"
+    env["TPUML_SCHEDULER__SWEEP_INTERVAL_S"] = "1.0"
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    agent_env = dict(env)
+    agent_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+    logs = {}
+    procs = {}
+
+    def _tail(name):
+        f = logs[name]
+        f.flush()
+        f.seek(0)
+        return f"--- {name}:\n" + f.read()[-3000:]
+
+    def _spawn(name, cmd, spawn_env):
+        logs[name] = open(tmp_path / f"{name}.log", "w+")
+        procs[name] = subprocess.Popen(
+            cmd, env=spawn_env, cwd=REPO,
+            stdout=logs[name], stderr=subprocess.STDOUT,
+        )
+        return procs[name]
+
+    def _get(path):
+        with urllib.request.urlopen(f"{url}{path}", timeout=5) as r:
+            return r.read().decode()
+
+    try:
+        server = _spawn(
+            "server", [sys.executable, "-c", SERVER_SCRIPT, str(port)], env
+        )
+        assert _wait_http(f"{url}/health", proc=server), _tail("server")
+
+        for name in ("hostA", "hostB"):
+            _spawn(
+                name,
+                [sys.executable, "-c", MESH_AGENT_SCRIPT, url], agent_env,
+            )
+
+        # both 4-device mesh slices registered and visible
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            for name, p in procs.items():
+                if p.poll() is not None:
+                    pytest.fail(f"{name} died early:\n{_tail(name)}")
+            try:
+                workers = json.loads(_get("/workers"))
+                if (
+                    len(workers) == 2
+                    and all(w.get("n_devices") == 4 for w in workers.values())
+                ):
+                    break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.5)
+        else:
+            pytest.fail(_tail("hostA") + _tail("hostB"))
+
+        from sklearn.linear_model import LogisticRegression
+        from sklearn.model_selection import GridSearchCV
+
+        from cs230_distributed_machine_learning_tpu import MLTaskManager
+
+        grid = {"C": [0.003, 0.01, 0.03, 0.1, 0.3, 1.0, 3.0, 10.0],
+                "tol": [1e-4, 1e-3]}  # 16 trials over >= 8 polls at max=2
+
+        m = MLTaskManager(url=url)
+        status_box = {}
+
+        def _run_job():
+            status_box["status"] = m.train(
+                GridSearchCV(LogisticRegression(max_iter=300), grid, cv=3),
+                "iris",
+                show_progress=False,
+                timeout=480,
+            )
+
+        t = threading.Thread(target=_run_job, daemon=True)
+        t.start()
+
+        # mid-job: SIGKILL one mesh host
+        deadline = time.time() + 180
+        killed = False
+        while time.time() < deadline and not killed:
+            try:
+                for j in json.loads(_get("/jobs")):
+                    done = j.get("completed_subtasks") or 0
+                    total = j.get("total_subtasks") or 99
+                    if 0 < done < total:
+                        procs["hostB"].send_signal(signal.SIGKILL)
+                        killed = True
+                        break
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.3)
+        assert killed, (
+            "job never reached a mid-flight state:\n" + _tail("hostA")
+        )
+
+        # the job completes on the surviving (reshaped) fabric
+        t.join(timeout=420)
+        assert not t.is_alive(), (
+            "job did not finish on the reshaped mesh:\n" + _tail("server")
+            + _tail("hostA")
+        )
+        status = status_box["status"]
+        assert status["job_status"] == "completed", status
+        result = status["job_result"]
+        assert len(result["results"]) == 16 and not result.get("failed"), (
+            result, _tail("hostA")
+        )
+
+        # the reshard is observable: generation >= 3 (2 joins + 1 death)
+        prom = _get("/metrics/prom")
+        gen_lines = [
+            ln for ln in prom.splitlines()
+            if ln.startswith("tpuml_mesh_generation")
+        ]
+        assert gen_lines, "tpuml_mesh_generation missing from /metrics/prom"
+        assert float(gen_lines[0].rsplit(" ", 1)[1]) >= 3, gen_lines
+
+        # score parity: the same search on the surviving fabric alone
+        clean = MLTaskManager(url=url).train(
+            GridSearchCV(LogisticRegression(max_iter=300), grid, cv=3),
+            "iris",
+            show_progress=False,
+            timeout=480,
+        )
+        assert clean["job_status"] == "completed"
+        best = result["best_result"]
+        clean_best = clean["job_result"]["best_result"]
+        assert best["parameters"] == clean_best["parameters"], (
+            best, clean_best
+        )
+        assert abs(
+            best["mean_cv_score"] - clean_best["mean_cv_score"]
+        ) <= 3e-3
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in procs.values():
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in logs.values():
+            f.close()

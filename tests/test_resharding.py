@@ -1,0 +1,244 @@
+"""Elastic trial fabric (docs/ARCHITECTURE.md "Elastic trial fabric"):
+mesh-generation tracking across worker join/death/evict, predictor-aware
+mesh packing (per-slice pricing at placement), the ``mesh_slice`` field on
+flight-recorder placement events, and journal replay of the generation
+counter across coordinator restarts."""
+
+import time
+
+from cs230_distributed_machine_learning_tpu.obs import RECORDER
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.predictor import RuntimePredictor
+from cs230_distributed_machine_learning_tpu.runtime.scheduler import PlacementEngine
+from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+
+
+class FixedPredictor(RuntimePredictor):
+    def __init__(self, est=10.0):
+        self.est = est
+        self.algo_weights = {}
+
+    def predict(self, task):
+        return self.est
+
+    def observe(self, task, actual):
+        pass
+
+
+def _task(stid, **kw):
+    return {"subtask_id": stid, "model_type": "LogisticRegression",
+            "mem_estimate_mb": 1.0, **kw}
+
+
+# ---------------- mesh generation ----------------
+
+
+def test_generation_bumps_on_join_death_evict_unsubscribe(monkeypatch):
+    eng = PlacementEngine(predictor=FixedPredictor())
+    changes = []
+    eng.on_mesh_change = lambda gen, reason, snap: changes.append(
+        (gen, reason, snap["total_devices"])
+    )
+    assert eng.mesh_generation == 0
+    wa = eng.subscribe(n_devices=4, mesh_shape={"trials": 4})
+    wb = eng.subscribe(n_devices=2)
+    wc = eng.subscribe()
+    assert eng.mesh_generation == 3
+    assert eng.total_devices() == 7
+    assert [c[1] for c in changes] == ["join", "join", "join"]
+    assert changes[-1][2] == 7
+
+    eng.unsubscribe(wb)
+    assert eng.mesh_generation == 4
+    assert eng.total_devices() == 5
+
+    eng.evict_worker(wc)
+    assert eng.mesh_generation == 5
+
+    # death via heartbeat silence: the sweep bumps too
+    monkeypatch.setattr(eng.cfg, "dead_after_s", 0.01)
+    eng.workers[wa].last_heartbeat = time.time() - 1
+    dead = eng.sweep()
+    assert dead == [wa]
+    assert eng.mesh_generation == 6
+    assert eng.total_devices() == 0
+    assert [c[1] for c in changes] == [
+        "join", "join", "join", "unsubscribe", "evict", "death",
+    ]
+
+
+def test_death_requeues_onto_reshaped_mesh_with_fresh_attempt(monkeypatch):
+    """A killed worker's in-flight trials resume on the reshaped fleet
+    with a fresh attempt id and the NEW generation stamp — the reshard
+    contract, no manual restart."""
+    eng = PlacementEngine(predictor=FixedPredictor(est=5.0))
+    monkeypatch.setattr(eng.cfg, "dead_after_s", 0.01)
+    wa = eng.subscribe(n_devices=8)
+    task = _task("st-0")
+    assert eng.place(task) == wa
+    gen_at_place = task["mesh_generation"]
+    wb = eng.subscribe(n_devices=2)  # join: bump
+    eng.workers[wa].last_heartbeat = time.time() - 1
+    requeued_before = list(eng.workers[wb].tasks_queue)
+    assert not requeued_before
+    eng.sweep()
+    # re-placed on the survivor, attempt bumped, generation moved on
+    queued = eng.workers[wb].tasks_queue
+    assert [t["subtask_id"] for t in queued] == ["st-0"]
+    assert queued[0]["attempt"] >= 1
+    assert queued[0]["mesh_generation"] > gen_at_place
+
+
+# ---------------- predictor-aware mesh packing ----------------
+
+
+def test_wide_slice_absorbs_expensive_work():
+    """Per-slice pricing: an 8-device slice finishes an 80s batch in ~10s,
+    so it wins the placement over an equally-fast 1-device worker."""
+    eng = PlacementEngine(predictor=FixedPredictor(est=80.0))
+    narrow = eng.subscribe(n_devices=1)
+    wide = eng.subscribe(n_devices=8, mesh_shape={"trials": 8})
+    t = _task("st-big")
+    assert eng.place(t) == wide
+    # the books absorbed the slice-priced estimate, not the raw one
+    assert abs(eng.workers[wide].load_seconds - 10.0) < 1e-9
+    assert eng.workers[wide].task_est["st-big"] == 10.0
+    assert eng.workers[narrow].load_seconds == 0.0
+
+
+def test_heterogeneous_batch_packs_across_slices():
+    """Wide trials and cheap trials must not serialize behind each other:
+    with one 8-wide and one 1-wide worker, a stream of expensive tasks
+    fills the wide slice while cheap tasks still land on the narrow
+    worker once the wide slice's queue has absorbed load."""
+
+    class PerTaskPredictor(FixedPredictor):
+        def predict(self, task):
+            return float(task.get("est", 10.0))
+
+    eng = PlacementEngine(predictor=PerTaskPredictor())
+    narrow = eng.subscribe(n_devices=1)
+    wide = eng.subscribe(n_devices=8)
+    placements = {}
+    for i in range(6):
+        t = _task(f"tree-{i}", est=400.0)  # wide-W tree trials
+        placements[t["subtask_id"]] = eng.place(t)
+    for i in range(6):
+        t = _task(f"lr-{i}", est=4.0)  # cheap LogReg trials
+        placements[t["subtask_id"]] = eng.place(t)
+    tree_on_wide = sum(
+        1 for k, v in placements.items()
+        if k.startswith("tree") and v == wide
+    )
+    lr_on_narrow = sum(
+        1 for k, v in placements.items()
+        if k.startswith("lr") and v == narrow
+    )
+    # every expensive task prefers the wide slice; at least some cheap
+    # ones flow to the narrow worker instead of queueing behind trees
+    assert tree_on_wide == 6
+    assert lr_on_narrow >= 1
+
+
+def test_placement_event_carries_mesh_slice():
+    eng = PlacementEngine(predictor=FixedPredictor(est=16.0))
+    eng.subscribe(n_devices=4, mesh_shape={"trials": 2, "data": 2})
+    task = _task("st-ev", job_id="job-ev")
+    eng.place(task)
+    events, _ = RECORDER.events(limit=10_000)
+    placements = [
+        e for e in events
+        if e["kind"] == "placement" and e["subtask_id"] == "st-ev"
+    ]
+    assert placements, "placement event missing"
+    ms = placements[-1]["data"]["mesh_slice"]
+    assert ms["n_devices"] == 4
+    assert ms["mesh_shape"] == {"trials": 2, "data": 2}
+    assert ms["generation"] == eng.mesh_generation
+    cand = placements[-1]["data"]["candidates"][0]
+    assert cand["n_devices"] == 4
+    # the task itself carries the generation stamp
+    assert task["mesh_generation"] == eng.mesh_generation
+
+
+def test_subscribe_report_reaches_engine_via_cluster():
+    cluster = ClusterRuntime()
+    try:
+        wid = cluster.register_remote(
+            n_devices=8, mesh_shape={"trials": 8}
+        )
+        w = cluster.engine.workers[wid]
+        assert w.n_devices == 8
+        assert w.mesh_shape == {"trials": 8}
+        snap = cluster.engine.worker_snapshot()[wid]
+        assert snap["n_devices"] == 8
+        health = cluster.engine.health_snapshot()[wid]
+        assert health["n_devices"] == 8
+    finally:
+        cluster.shutdown()
+
+
+# ---------------- journal replay of the generation ----------------
+
+
+def test_store_replays_mesh_generation(tmp_path):
+    d = str(tmp_path / "journal")
+    store = JobStore(journal_dir=d)
+    store.record_mesh_generation(2, "join")
+    store.record_mesh_generation(5, "death")
+    replayed = JobStore(journal_dir=d)
+    assert replayed.mesh_generation == 5
+    assert replayed.replay_ops.get("mesh_gen") == 2
+
+
+def test_coordinator_journals_and_recovers_generation(tmp_path):
+    d = str(tmp_path / "journal")
+    cluster = ClusterRuntime()
+    coord = Coordinator(cluster=cluster, journal=True, journal_dir=d)
+    try:
+        cluster.add_executor()
+        cluster.add_executor()
+        gen = cluster.engine.mesh_generation
+        assert gen >= 2
+        assert coord.store.mesh_generation == gen
+    finally:
+        cluster.shutdown()
+
+    # a restarted coordinator resumes the counter monotonically — the
+    # journal replays the reshard history (including the shutdown's
+    # unsubscribe bumps) into the fresh engine
+    cluster2 = ClusterRuntime()
+    try:
+        coord2 = Coordinator(cluster=cluster2, journal=True, journal_dir=d)
+        replayed = coord2.store.mesh_generation
+        assert replayed >= gen + 2  # 2 joins + 2 shutdown unsubscribes
+        assert cluster2.engine.mesh_generation >= replayed
+        # the next join continues past the replayed history
+        cluster2.add_executor()
+        assert cluster2.engine.mesh_generation >= replayed + 1
+    finally:
+        cluster2.shutdown()
+
+
+def test_predictor_fed_device_normalized_walls():
+    """The double-division guard: a wall measured on an N-device slice is
+    already slice-shortened, so the predictor must be fed actual x
+    n_devices (it learns device-normalized costs; place() divides by the
+    candidate's width exactly once)."""
+    observed = []
+
+    class Recorder(FixedPredictor):
+        def observe(self, task, actual):
+            observed.append(actual)
+
+    eng = PlacementEngine(predictor=Recorder(est=80.0))
+    wid = eng.subscribe(n_devices=8)
+    eng.place(_task("st-n"))
+    t0 = time.time()
+    eng.on_metrics({
+        "worker_id": wid, "subtask_id": "st-n",
+        "started_at": t0 - 2.0, "finished_at": t0,
+    })
+    assert len(observed) == 1
+    assert abs(observed[0] - 16.0) < 0.1  # 2s wall x 8-device slice

@@ -500,3 +500,112 @@ def test_frontend_prometheus_label_injection():
     assert 'm{shard="2"} 3' in lines
     assert 'n{shard="2",route="train",code="200"} 1.5' in lines
     assert "# HELP m help" in lines
+
+
+def test_qos_lane_aging_prevents_starvation():
+    """ROADMAP item 2 follow-up: strict-priority lanes age — a waiting
+    low-lane message is promoted one lane per qos_aging_s, so a
+    sustained high-priority flood cannot starve lane 0 forever."""
+    from cs230_distributed_machine_learning_tpu.runtime.queue import TopicBus
+
+    bus = TopicBus()
+    sub = bus.subscribe("tasks", priority=True, aging_s=0.05)
+    bus.publish("tasks", {"priority": 0, "tag": "starved"})
+    time.sleep(0.12)  # > 2 aging periods: promoted past lane 1
+    for i in range(16):
+        bus.publish("tasks", {"priority": 1, "tag": f"flood-{i}"})
+    # the aged lane-0 message is delivered FIRST (promoted into lane >=1
+    # with the oldest sequence number), not after the entire flood
+    assert sub.get(timeout=1)[1]["tag"] == "starved"
+
+    # aging off (<=0): pure strict priority, the flood wins
+    strict = bus.subscribe("tasks2", priority=True, aging_s=0)
+    bus.publish("tasks2", {"priority": 0, "tag": "low"})
+    time.sleep(0.06)
+    bus.publish("tasks2", {"priority": 1, "tag": "high"})
+    assert strict.get(timeout=1)[1]["tag"] == "high"
+
+
+def test_frontend_streams_large_bodies_zero_copy():
+    """ROADMAP item 2 follow-up: the front end relays large request
+    bodies to the owning shard chunk-wise (Content-Length preserved,
+    body bit-identical) WITHOUT buffering the whole body per hop —
+    pinned by forbidding Request.get_data for large payloads."""
+    import hashlib
+    import http.server
+    import os
+
+    from werkzeug.test import Client
+    from werkzeug.wrappers import Request
+
+    from cs230_distributed_machine_learning_tpu.runtime import frontend as fe
+
+    received = {}
+
+    class EchoShard(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            h = hashlib.sha1()
+            remaining = length
+            while remaining > 0:
+                chunk = self.rfile.read(min(65536, remaining))
+                if not chunk:
+                    break
+                h.update(chunk)
+                remaining -= len(chunk)
+            received["sha1"] = h.hexdigest()
+            received["length"] = length
+            received["te"] = self.headers.get("Transfer-Encoding")
+            body = json.dumps({"status": "ok"}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), EchoShard)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        app = fe.create_frontend_app(
+            [f"http://127.0.0.1:{srv.server_address[1]}"]
+        )
+        client = Client(app)
+        big = os.urandom(2 * fe._STREAM_BODY_MIN)  # 512 KB
+
+        original_get_data = Request.get_data
+
+        def guarded_get_data(self, *a, **kw):
+            if (self.content_length or 0) >= fe._STREAM_BODY_MIN:
+                raise AssertionError(
+                    "front end buffered a large body via get_data()"
+                )
+            return original_get_data(self, *a, **kw)
+
+        Request.get_data = guarded_get_data
+        try:
+            resp = client.post(
+                "/train/some-session", data=big,
+                content_type="application/octet-stream",
+            )
+        finally:
+            Request.get_data = original_get_data
+        assert resp.status_code == 200
+        assert received["length"] == len(big)
+        assert received["sha1"] == hashlib.sha1(big).hexdigest()
+        # streamed with a declared length, not chunked transfer-encoding
+        assert received["te"] is None
+
+        # small bodies keep the simple buffered path (and still arrive)
+        small = b'{"x": 1}'
+        resp = client.post(
+            "/train/some-session", data=small,
+            content_type="application/json",
+        )
+        assert resp.status_code == 200
+        assert received["length"] == len(small)
+    finally:
+        srv.shutdown()
