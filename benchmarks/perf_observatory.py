@@ -632,6 +632,23 @@ def cash_in(
         artifact="benchmarks/COLD_PROFILE_MEASURED.json",
     )
 
+    if backend == "tpu":
+        # out-of-core streaming over the REAL host->HBM link (PR 16): the
+        # OOM repro + the double-buffer overlap profile, where hiding the
+        # ~9 MB/s tunnel transfer is worth seconds per pass
+        sections["streaming_micro"] = _run_sub(
+            [py, "benchmarks/streaming_micro.py"], 1800,
+            artifact="benchmarks/STREAMING_MICRO.json",
+        )
+    else:
+        sections["streaming_micro"] = {
+            "skipped": f"requires TPU link (backend={backend}); the "
+                       "CPU-measured OOM repro + overlap profile is "
+                       "committed in benchmarks/STREAMING_MICRO.json — "
+                       "on a chip this section re-measures the "
+                       "host->HBM overlap via streaming_micro.py",
+        }
+
     sections["valve_ab"] = {"components": components, "skipped": comp_skipped}
     return sections
 

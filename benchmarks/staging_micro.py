@@ -101,6 +101,98 @@ def main() -> None:
             "tunnel_upload_s_modeled": round(nbytes / (TUNNEL_MBPS * 1e6), 2),
         }
     f32_bytes = modes["f32"]["bytes_on_link"]
+
+    # streamed-tile row (PR 16): the same matrix shipped as row blocks
+    # through the double-buffered streamer instead of one device_put —
+    # per-block upload wall, aggregate vs single-shot, and the hidden
+    # fraction when a per-block compute runs behind the prefetcher
+    from cs230_distributed_machine_learning_tpu.data.stage_cache import (
+        StagedDatasetCache,
+    )
+    from cs230_distributed_machine_learning_tpu.data.streaming import (
+        RowBlockStreamer, array_block_source, plan_blocks,
+    )
+    import jax.numpy as jnp
+
+    bplan = plan_blocks(X.shape[0], row_bytes=X.shape[1] * 4, rows=16384)
+
+    @jax.jit
+    def _touch(blk):
+        if isinstance(blk, dict):  # compressed staged form
+            blk = _stage_decode(blk)
+        return jnp.tanh(blk).sum()
+
+    streamed_tiles = {
+        "block_rows": bplan.rows,
+        "n_blocks": bplan.n_blocks,
+        "single_shot_upload_ms_measured":
+            modes["f32"]["upload_ms_measured"],
+        "modes": {},
+        "note": (
+            "row-block streaming (data/streaming.py) over the same "
+            "matrix, per CS230_STAGE_DTYPE block form: the pass pays "
+            "per-block device_puts but hides them behind the per-block "
+            "compute; block_upload_mb_per_s_measured is bytes_on_link / "
+            "upload wall — the effective per-block link bandwidth. The "
+            "full overlap study is benchmarks/STREAMING_MICRO.json"
+        ),
+    }
+    for smode in ("f32", "bf16", "int8"):
+        if _stage_mode_available(smode) != smode:
+            streamed_tiles["modes"][smode] = {
+                "skipped": "stage dtype unavailable (ml_dtypes missing)"
+            }
+            continue
+
+        def _ship(b, _m=smode):
+            staged = _stage_compress(np.ascontiguousarray(b), _m)
+            return jax.tree_util.tree_map(jnp.asarray, staged) \
+                if isinstance(staged, dict) else jnp.asarray(staged)
+
+        jax.block_until_ready(
+            _touch(_ship(np.zeros((bplan.rows, X.shape[1]), np.float32)))
+        )
+        tile_walls, hidden_fracs, upload_ws, link_bytes = [], [], [], []
+        for _ in range(REPS):
+            streamer = RowBlockStreamer(
+                ("staging_micro", ("bench", 0), "block", "tiles", smode),
+                array_block_source(X, bplan),
+                _ship,
+                bplan,
+                double_buffer=True,
+                cache=StagedDatasetCache(),  # fresh: every block uploads
+                row_shape=(X.shape[1],),
+            )
+            t0 = time.perf_counter()
+            for _i, _s, blk in streamer.iter_blocks():
+                _touch(blk)
+            tile_walls.append(time.perf_counter() - t0)
+            st = streamer.stats
+            upload_ws.append(st["upload_s"])
+            link_bytes.append(st["bytes"])
+            hf = streamer.hidden_fraction()
+            if hf is not None:
+                hidden_fracs.append(hf)
+        up_s = float(np.median(upload_ws))
+        nbytes_link = float(np.median(link_bytes))
+        streamed_tiles["modes"][smode] = {
+            "block_mb_on_link": round(
+                nbytes_link / max(bplan.n_blocks, 1) / 1e6, 2
+            ),
+            "pass_wall_ms_measured": round(
+                float(np.median(tile_walls)) * 1e3, 2
+            ),
+            "block_upload_ms_measured": round(
+                up_s / max(bplan.n_blocks, 1) * 1e3, 2
+            ),
+            "block_upload_mb_per_s_measured": round(
+                nbytes_link / max(up_s, 1e-9) / 1e6, 1
+            ),
+            "hidden_frac_double_buffered": round(
+                float(np.median(hidden_fracs)), 4
+            ) if hidden_fracs else None,
+        }
+
     # the auto-policy probe: the same 4 MiB device_put measurement
     # run_trials consults when CS230_STAGE_DTYPE=auto picks a dtype
     link_mbps = _measured_link_mbps()
@@ -121,6 +213,7 @@ def main() -> None:
             "rule": "bf16 when measured link < threshold, else f32",
         },
         "modes": modes,
+        "streamed_tiles": streamed_tiles,
         "saving_vs_f32": {
             m: round(1.0 - v["bytes_on_link"] / f32_bytes, 3)
             for m, v in modes.items() if "bytes_on_link" in v

@@ -74,6 +74,23 @@ def enabled() -> bool:
     return os.environ.get("CS230_STAGE_CACHE", "1") != "0"
 
 
+def strict_enabled() -> bool:
+    """CS230_STAGE_STRICT=1 turns the stage budget from advisory into a
+    hard ceiling: a single tunnel upload larger than ``budget_bytes()``
+    raises :class:`StageBudgetExceeded` instead of staging anyway. On a
+    real device that oversize ``device_put`` is an HBM OOM; the strict
+    valve reproduces the failure deterministically on CPU, which is how
+    the streaming OOM-repro benchmark/tests pin "legacy staging fails
+    where CS230_STREAM completes" (benchmarks/streaming_micro.py)."""
+    return os.environ.get("CS230_STAGE_STRICT", "0") == "1"
+
+
+class StageBudgetExceeded(RuntimeError):
+    """A single staged entry exceeds the stage-cache budget under
+    ``CS230_STAGE_STRICT=1`` — the CPU-deterministic stand-in for the
+    device OOM the same upload would hit on real hardware."""
+
+
 def budget_bytes() -> int:
     """Device-memory budget for staged entries. ``CS230_STAGE_CACHE_MB``
     pins it; the default is 40% of the device's reported bytes_limit
@@ -240,6 +257,41 @@ class StagedDatasetCache:
             if entry is not None:
                 entry.refs += 1
 
+    # ---------------- explicit refs (cross-thread pins) ----------------
+    #
+    # Pin scopes are thread-local, which is right for a run's own thread
+    # but useless for the streaming prefetch worker: it stages block i+1
+    # on a different thread than the one consuming block i. acquire()
+    # therefore takes an explicit ref on the staged entry that release()
+    # drops from ANY thread — the streamer holds one per in-flight or
+    # prefetched block so LRU pressure can never evict them mid-pass.
+
+    def acquire(
+        self, key: Any, make: Callable[[], Any], *,
+        transport: str = "tunnel", ici_bytes: Optional[int] = None,
+    ) -> Tuple[Any, str]:
+        """``get_or_stage`` plus one explicit ref on the entry. The loop
+        closes the stage->pin race: if the entry was evicted between the
+        stage returning and the ref landing (another tenant's burst), we
+        simply re-stage — the ref is only ever taken on a live entry
+        holding the value we are about to hand out."""
+        while True:
+            value, outcome = self.get_or_stage(
+                key, make, transport=transport, ici_bytes=ici_bytes
+            )
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None and entry.value is value:
+                    entry.refs += 1
+                    return value, outcome
+
+    def release(self, key: Any) -> None:
+        """Drop one explicit ref taken by :meth:`acquire`."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.refs = max(0, entry.refs - 1)
+
     # ---------------- lookup / staging ----------------
 
     def get_or_stage(
@@ -292,8 +344,31 @@ class StagedDatasetCache:
         wall_s = time.perf_counter() - t0
         nbytes = _tree_nbytes(value)
         ici = transport == "ici"
+        budget = budget_bytes()
+        if not ici and strict_enabled() and nbytes > budget:
+            # strict budget: refuse the oversize upload (the CPU stand-in
+            # for the HBM OOM it would be on device) and release waiters
+            # — they will retry and hit the same ceiling deterministically
+            with self._lock:
+                self._stats["unevictable_overflows"] += 1
+                self._inflight.pop(key, None)
+            ev.set()
+            del value
+            counter_inc("tpuml_stage_cache_overflow_total")
+            record_event(
+                "stage.overflow", key=repr(key), nbytes=nbytes,
+                budget_bytes=budget, reason="strict",
+            )
+            raise StageBudgetExceeded(
+                f"staged entry {key!r} is {nbytes / 1e6:.1f} MB but the "
+                f"stage budget is {budget / 1e6:.1f} MB "
+                "(CS230_STAGE_STRICT=1); stream the dataset instead "
+                "(CS230_STREAM, data/streaming.py) or raise "
+                "CS230_STAGE_CACHE_MB"
+            )
         moved = int(ici_bytes) if (ici and ici_bytes is not None) else nbytes
         evicted: List[Tuple[Any, int]] = []
+        overflow = 0
         with self._lock:
             self._entries[key] = _Entry(value, nbytes)
             self._entries.move_to_end(key)
@@ -307,7 +382,7 @@ class StagedDatasetCache:
                 self._stats["tunnel_bytes"] += nbytes
                 self._uploads_by_key[key] += 1
             self._pin_locked(key)
-            evicted = self._evict_over_budget_locked(exclude=key)
+            evicted, overflow = self._evict_over_budget_locked(exclude=key)
             total_bytes, n_entries = self._bytes, len(self._entries)
             # entry inserted: waiters must see it BEFORE the event fires,
             # or they would loop back into a duplicate upload
@@ -331,18 +406,33 @@ class StagedDatasetCache:
         for ekey, enbytes in evicted:
             counter_inc("tpuml_stage_cache_evictions_total")
             record_event("stage.evict", key=repr(ekey), nbytes=enbytes)
+        if overflow:
+            # every survivor was pinned: the cache is committed beyond
+            # its budget. The overflow is forced (live tensors are never
+            # dropped) but no longer silent — operators alert on the
+            # counter, the flight recorder carries the context.
+            counter_inc("tpuml_stage_cache_overflow_total")
+            record_event(
+                "stage.overflow", key=repr(key), nbytes=nbytes,
+                overflow_bytes=overflow, budget_bytes=budget,
+                cache_bytes=total_bytes, cache_entries=n_entries,
+                reason="pinned",
+            )
         return value, "miss"
 
     def _evict_over_budget_locked(
         self, exclude: Any = None
-    ) -> List[Tuple[Any, int]]:
+    ) -> Tuple[List[Tuple[Any, int]], int]:
         """LRU eviction down to the budget, skipping pinned entries and
         the just-inserted key (a single over-budget dataset must stage and
-        serve its run, then age out). Returns the evicted (key, nbytes)."""
+        serve its run, then age out). Returns the evicted (key, nbytes)
+        plus the bytes still over budget after eviction (non-zero only
+        when every survivor is pinned — the caller emits the overflow
+        counter/event outside the lock)."""
         budget = budget_bytes()
         evicted: List[Tuple[Any, int]] = []
         if self._bytes <= budget:
-            return evicted
+            return evicted, 0
         for key in list(self._entries):
             if self._bytes <= budget:
                 break
@@ -353,7 +443,8 @@ class StagedDatasetCache:
             self._bytes -= entry.nbytes
             self._stats["evictions"] += 1
             evicted.append((key, entry.nbytes))
-        if self._bytes > budget:
+        overflow = max(self._bytes - budget, 0)
+        if overflow:
             # every survivor is pinned (or the newcomer itself): nothing
             # more can go — record the overflow, never drop live tensors
             self._stats["unevictable_overflows"] += 1
@@ -364,7 +455,7 @@ class StagedDatasetCache:
                 len(evicted), sum(nb for _, nb in evicted) / 1e6,
                 budget / 1e6,
             )
-        return evicted
+        return evicted, overflow
 
     # ---------------- introspection / tests ----------------
 

@@ -63,8 +63,13 @@ class LogisticRegressionKernel(ModelKernel):
         ``_masked_grad_mode`` / ``_fused_step_mode``) — both must key
         every executable cache like the tree histogram knobs do. The salt
         carries the RESOLVED modes, not the raw strings: invalid/alias
-        values collapse to the same behavior and must share a cache key."""
-        return (_masked_grad_mode(), _fused_step_mode())
+        values collapse to the same behavior and must share a cache key.
+        CS230_STREAM joins them (resolved off/auto/force): the streamed
+        and single-shot drivers stage different dataset forms, so every
+        executable/prepared cache must re-key when the valve moves."""
+        from ..data.streaming import stream_mode
+
+        return (_masked_grad_mode(), _fused_step_mode(), stream_mode())
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         if static.get("penalty") not in ("l2", None, "none"):
@@ -134,6 +139,33 @@ class LogisticRegressionKernel(ModelKernel):
         cap = _NEWTON_STEPS if static["_method"] == "newton" else _NESTEROV_STEPS
         max_iters = [int(h.get("max_iter", 100)) for h in hypers] or [cap]
         return {**static, "_iters": max(1, min(cap, max(max_iters)))}
+
+    # ---- out-of-core row-block streaming (data/streaming.py) ----
+
+    def stream_applicable(self, static: Dict[str, Any], n: int, d: int) -> bool:
+        """Only the Nesterov driver accumulates across row blocks: its
+        gradient and power-iteration are row sums. Newton's Hessian
+        solve wants the whole workspace resident — and its n-threshold
+        (``_NEWTON_MAX_WORKSPACE``) keeps it under any realistic stage
+        budget anyway."""
+        return static.get("_method") == "nesterov"
+
+    def stream_form(self, X_np, static: Dict[str, Any]):
+        """Engine hook: the row-major host array blocks are sliced from,
+        plus a salt naming the form in block cache keys."""
+        return np.asarray(X_np, np.float32), ("raw", "f32")
+
+    def stream_scores(self, streamer, y_pad, TW, EW, hyper_batch, static, n):
+        """Block-accumulated Nesterov over a RowBlockStreamer: one pass
+        per solver iteration (plus 31 Lipschitz passes and one eval
+        pass), partial gradients summed across blocks — the ``fit`` +
+        ``weighted_accuracy`` composition restructured so no array of
+        ``n`` rows is ever device-resident. Pad rows carry zero sample
+        weight, so every block-sum matches the single-shot value up to
+        f32 summation order (the parity tests/test_streaming.py pins)."""
+        return _stream_nesterov_scores(
+            streamer, y_pad, TW, EW, hyper_batch, static, n
+        )
 
     def predict(self, params, X, static: Dict[str, Any]):
         fit_intercept = bool(static.get("fit_intercept", True))
@@ -693,3 +725,175 @@ def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps=_NESTEROV_STEPS):
         jnp.arange(steps, dtype=jnp.float32),
     )
     return W
+
+
+# ---------------- out-of-core streamed Nesterov driver ----------------
+#
+# The single-shot path stages the full [n, dp] design matrix and lets
+# jax.lax.scan drive _nesterov over it. Past the stage budget that staging
+# is exactly the OOM the streaming layer exists to avoid, so this driver
+# restructures the same solver around row blocks: every quantity the
+# solver reduces over rows (the power-iteration application, the masked
+# gradient, the weighted-accuracy numerator) becomes a sum of per-block
+# partial reductions, accumulated in f32 across one streamed pass per
+# solver step. Block order is fixed (ascending), so results are
+# deterministic; they differ from the single-shot values only by f32
+# summation order (tests/test_streaming.py pins the tolerance). The
+# trial/split axes stay batched on device — resident state is
+# W/W_prev/V/G at [T, S, dp, c] plus the fold tensors, independent of n.
+
+_STREAM_FN_CACHE: Dict[Any, Any] = {}
+
+
+def _stream_fns(rows, d, c, S, T, fit_intercept, lam):
+    """Jitted per-block / per-iteration pieces of the streamed Nesterov
+    solver, cached on geometry: the engine re-enters stream_scores once
+    per trial chunk and every repeat chunk re-dispatches these."""
+    key = (rows, d, c, S, T, bool(fit_intercept), float(lam))
+    fns = _STREAM_FN_CACHE.get(key)
+    if fns is not None:
+        return fns
+
+    from ..data.streaming import decode_block
+
+    dp = d + (1 if fit_intercept else 0)
+    pen = np.ones((dp, c), np.float32)
+    if fit_intercept:
+        pen[-1, :] = 0.0
+    pen_mask = jnp.asarray(pen)
+
+    def design(blk):
+        return add_intercept(decode_block(blk), bool(fit_intercept))
+
+    @jax.jit
+    def power_block(blk, u, v, TW, start):
+        # one block's contribution to u = A' diag(w) A v, all splits
+        A = design(blk)
+        wb = jax.lax.dynamic_slice(TW, (0, start), (S, rows))
+        t = jnp.einsum("rd,sd->sr", A, v)
+        return u + jnp.einsum("sr,rd->sd", wb * t, A)
+
+    @jax.jit
+    def power_norm(u):
+        return u / jnp.maximum(
+            jnp.linalg.norm(u, axis=1, keepdims=True), 1e-12
+        )
+
+    @jax.jit
+    def extrapolate(W, Wp, t):
+        mom = t / (t + 3.0)
+        return W + mom * (W - Wp)
+
+    @jax.jit
+    def grad_block(blk, G, V, y_pad, TW, start):
+        # the fused masked-gradient formulation of _make_masked_grad_fn,
+        # restricted to one block: bf16 matmul inputs, f32 accumulation.
+        # Pad rows have wb == 0, so both their softmax term and their
+        # label term vanish exactly.
+        A = design(blk)
+        yb = jax.lax.dynamic_slice(y_pad, (start,), (rows,))
+        wb = jax.lax.dynamic_slice(TW, (0, start), (S, rows))
+        Z = jnp.einsum(
+            "rd,tsdc->tsrc",
+            A.astype(jnp.bfloat16), V.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+        e = jnp.exp(Z - jnp.max(Z, axis=-1, keepdims=True))
+        scale = wb[None] / jnp.sum(e, axis=-1)            # [T, S, rows]
+        Yb = jax.nn.one_hot(yb, c, dtype=jnp.float32)
+        WY = wb[:, :, None] * Yb[None]                    # [S, rows, c]
+        R = e * scale[..., None] - WY[None]
+        return G + jnp.einsum(
+            "rd,tsrc->tsdc",
+            A.astype(jnp.bfloat16), R.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        )
+
+    @jax.jit
+    def update(W, Wp, V, G_raw, t, done, lam_max, C, max_iter, tol):
+        # _nesterov's scan body, batched over (trial, split) lanes, with
+        # the cross-block gradient sum supplied instead of grad_fn(V)
+        G = C[:, None, None, None] * G_raw + lam * pen_mask[None, None] * V
+        gmax = jnp.max(jnp.abs(G), axis=(2, 3))           # [T, S]
+        L = 0.5 * C[:, None] * lam_max[None, :] + lam + 1e-6
+        step = (1.0 / L)[:, :, None, None]
+        active = jnp.logical_and(t < max_iter[:, None], jnp.logical_not(done))
+        a4 = active[:, :, None, None]
+        W_new = jnp.where(a4, V - step * G, W)
+        Wp_new = jnp.where(a4, W, Wp)
+        done = jnp.logical_or(done, gmax < tol[:, None])
+        idle = jnp.logical_or(done, (t + 1.0) >= max_iter[:, None])
+        return W_new, Wp_new, done, jnp.all(idle)
+
+    @jax.jit
+    def eval_block(blk, acc, W, y_pad, EW, start):
+        # weighted-accuracy numerator, one block at a time (pad rows
+        # carry zero eval weight); f32 logits like predict()
+        A = design(blk)
+        yb = jax.lax.dynamic_slice(y_pad, (start,), (rows,))
+        ewb = jax.lax.dynamic_slice(EW, (0, start), (S, rows))
+        Z = jnp.einsum("rd,tsdc->tsrc", A, W)
+        hit = (jnp.argmax(Z, axis=-1) == yb[None, None, :]).astype(jnp.float32)
+        return acc + jnp.einsum("sr,tsr->ts", ewb, hit)
+
+    fns = (power_block, power_norm, extrapolate, grad_block, update, eval_block)
+    _STREAM_FN_CACHE[key] = fns
+    return fns
+
+
+def _stream_nesterov_scores(streamer, y_pad, TW, EW, hyper_batch, static, n):
+    n_classes = int(static["_n_classes"])
+    c = max(n_classes, 2)
+    fit_intercept = bool(static.get("fit_intercept", True))
+    use_penalty = static.get("penalty") in ("l2",)
+    lam = (1.0 if use_penalty else 0.0) * (2.0 if n_classes == 2 else 1.0)
+
+    C = jnp.asarray(np.asarray(hyper_batch["C"], np.float32))
+    max_iter = jnp.asarray(np.asarray(hyper_batch["max_iter"], np.float32))
+    tol = jnp.asarray(np.asarray(hyper_batch["tol"], np.float32))
+    T = int(C.shape[0])
+    S = int(TW.shape[0])
+    rows = int(streamer.plan.rows)
+    d = int(streamer.row_shape[0])
+    dp = d + (1 if fit_intercept else 0)
+    steps = int(static.get("_iters", _NESTEROV_STEPS))
+
+    power_block, power_norm, extrapolate, grad_block, update, eval_block = (
+        _stream_fns(rows, d, c, S, T, fit_intercept, lam)
+    )
+
+    # Lipschitz bound: _nesterov's 30-step power iteration plus the
+    # Rayleigh quotient — 31 streamed applications of A' diag(w) A
+    v = jnp.ones((S, dp), jnp.float32)
+    u = jnp.zeros((S, dp), jnp.float32)
+    for it in range(31):
+        u = jnp.zeros((S, dp), jnp.float32)
+        for _i, start, blk in streamer.iter_blocks():
+            u = power_block(blk, u, v, TW, jnp.asarray(start, jnp.int32))
+        if it < 30:
+            v = power_norm(u)
+    lam_max = jnp.sum(v * u, axis=1)                      # [S]
+
+    W = jnp.zeros((T, S, dp, c), jnp.float32)
+    Wp = W
+    done = jnp.zeros((T, S), bool)
+    for t in range(steps):
+        tf = jnp.asarray(t, jnp.float32)
+        V = extrapolate(W, Wp, tf)
+        G = jnp.zeros((T, S, dp, c), jnp.float32)
+        for _i, start, blk in streamer.iter_blocks():
+            G = grad_block(blk, G, V, y_pad, TW, jnp.asarray(start, jnp.int32))
+        W, Wp, done, idle = update(
+            W, Wp, V, G, tf, done, lam_max, C, max_iter, tol
+        )
+        # host-visible early exit: once every (trial, split) lane is
+        # converged or past its max_iter, the remaining scan steps would
+        # be masked no-ops — each costing a full pass over the blocks
+        if bool(idle):
+            break
+
+    acc = jnp.zeros((T, S), jnp.float32)
+    for _i, start, blk in streamer.iter_blocks():
+        acc = eval_block(blk, acc, W, y_pad, EW, jnp.asarray(start, jnp.int32))
+    den = jnp.maximum(jnp.sum(EW.astype(jnp.float32), axis=1), 1e-12)
+    return np.asarray(acc / den[None, :], np.float32)

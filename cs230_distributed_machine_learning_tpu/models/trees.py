@@ -183,10 +183,14 @@ class _TreeBase(ModelKernel):
         """ops/trees.py env knobs read at trace/import time that change the
         compiled program but don't land in ``static`` — they must key every
         executable cache (same hazard the SVC solver knobs hit: a knob flip
-        silently reloading the pre-knob AOT blob)."""
+        silently reloading the pre-knob AOT blob). CS230_STREAM (resolved)
+        joins them: the streamed and single-shot drivers stage different
+        dataset forms under different keys."""
+        from ..data.streaming import stream_mode
         from ..ops.trees import _hist_kernel_mode
 
         return (
+            stream_mode(),
             os.environ.get("CS230_DEEP_WSCHED", ""),
             _hist_kernel_mode(),  # resolved, not raw: aliases share a key
             os.environ.get("CS230_HIST_COMPACT", "0"),
@@ -793,6 +797,106 @@ class _RandomForestBase(_TreeBase):
         if isinstance(X, dict):
             params["edges"] = X["edges"]
         return params
+
+    # ---- out-of-core row-block streaming (data/streaming.py) ----
+
+    def stream_applicable(self, static: Dict[str, Any], n: int, d: int) -> bool:
+        """Complete-tree classification forests only. The deep arena's
+        frontier compaction keeps [n, W] routing masks resident and
+        re-bins per level — not block-accumulable; regression float
+        stats would trade the bitwise histogram guarantee for f32-order
+        drift in the SPLITS themselves (not just the scores), so those
+        families fall back to single-shot (or chunked) staging."""
+        return (
+            not static.get("_deep")
+            and self.task == "classification"
+            and int(static.get("_depth", 0)) >= 1
+        )
+
+    def stream_form(self, X_np, static: Dict[str, Any]):
+        """Blocks are sliced from the prepared bin-code matrix (the only
+        per-row array the builder reads); edges/X stay host-side."""
+        xb = X_np["xb"] if isinstance(X_np, dict) else np.asarray(X_np)
+        return np.ascontiguousarray(xb), ("xb", int(static["_n_bins"]))
+
+    def stream_scores(self, streamer, y_pad, TW, EW, hyper_batch, static, n):
+        """Block-accumulated forest fit + soft-vote accuracy over a
+        RowBlockStreamer: (depth + 1) passes per tree via
+        ops/trees.build_tree_streamed, which is BITWISE build_tree for
+        these integer-stat histograms — per-tree splits and leaf values
+        are identical to the single-shot path, per-tree keys stay
+        ``fold_in(t)``, and bootstrap counts are drawn on the UNPADDED
+        row range so the multinomial stream matches exactly. Prediction
+        for the fitting rows reuses the builder's final node ids — a
+        resident leaf lookup, no extra pass."""
+        from ..data.streaming import decode_block
+        from ..ops.trees import _LOOKUP_M, _leaf_select, build_tree_streamed
+
+        c = max(int(static["_n_classes"]), 2)
+        n_splits = int(TW.shape[0])
+        n_pad = int(TW.shape[1])
+        d = int(streamer.row_shape[0])
+        depth = int(static["_depth"])
+        n_bins = int(static["_n_bins"])
+        mf = static["_mf"] if static["_mf"] < d else None
+        n_trees = int(static.get("n_estimators", 100))
+        base_key = jax.random.PRNGKey(static["_seed"])
+        n_internal = 2**depth - 1
+        n_leaves = 2**depth
+
+        def stream_pass(fn, carry, *consts):
+            for _i, start, blk in streamer.iter_blocks():
+                carry = fn(
+                    carry, *consts, decode_block(blk),
+                    jnp.asarray(start, jnp.int32),
+                )
+            return carry
+
+        y1 = jax.nn.one_hot(y_pad, c, dtype=jnp.float32)       # [n_pad, c]
+        pad_zeros = jnp.zeros((n_pad - int(n),), jnp.float32)
+        scores = np.zeros((n_splits,), np.float32)
+        for s in range(n_splits):
+            w = TW[s].astype(jnp.float32)
+            Sw = y1 * w[:, None]
+            acc = jnp.zeros((n_pad, c), jnp.float32)
+            for t in range(n_trees):
+                key = jax.random.fold_in(base_key, t)
+                boot_key, feat_key = jax.random.split(key)
+                if static.get("bootstrap", True):
+                    counts = jnp.concatenate(
+                        [_bootstrap_counts(boot_key, w[: int(n)], int(n)),
+                         pad_zeros]
+                    )
+                else:
+                    counts = (w > 0).astype(jnp.float32)
+                tree, node = build_tree_streamed(
+                    stream_pass,
+                    Sw * counts[:, None],
+                    w * counts,
+                    d,
+                    depth=depth,
+                    n_bins=n_bins,
+                    min_samples_leaf=static["_msl"],
+                    max_features=mf,
+                    key=feat_key,
+                    precision=jax.lax.Precision.DEFAULT,
+                    count_from_stats=True,
+                )
+                leaf_local = node - n_internal
+                if n_leaves <= _LOOKUP_M:
+                    vals = _leaf_select(leaf_local, tree["leaf_val"], n_leaves)
+                else:
+                    vals = tree["leaf_val"][leaf_local]
+                acc = acc + vals
+            mean = acc / float(n_trees)
+            pred = jnp.argmax(mean, axis=-1).astype(jnp.int32)
+            ew = EW[s].astype(jnp.float32)
+            num = jnp.sum((pred == y_pad).astype(jnp.float32) * ew)
+            scores[s] = float(num / jnp.maximum(jnp.sum(ew), 1e-12))
+        # trials in one bucket share an identical static config (RF hypers
+        # are static), so every trial of the chunk gets the same row
+        n_t = len(next(iter(hyper_batch.values()))) if hyper_batch else 1
+        return np.broadcast_to(scores, (max(int(n_t), 1), n_splits)).copy()
 
     def _forest_leaf_mean(self, params, xq, static):
         trees = params["trees"]

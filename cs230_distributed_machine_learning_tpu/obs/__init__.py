@@ -315,6 +315,35 @@ def register_catalog() -> None:
         "mesh-shaped staged entries",
     )
     c(
+        "tpuml_stage_cache_overflow_total",
+        "Stage-budget overflows: every LRU survivor was pinned so the "
+        "cache is committed beyond its budget (reason=pinned), or "
+        "CS230_STAGE_STRICT refused an oversize upload (reason=strict)",
+    )
+    # ---- out-of-core row-block streaming (docs/ARCHITECTURE.md
+    # "Out-of-core streaming") ----
+    c(
+        "tpuml_stream_blocks_total",
+        "Row blocks served to streaming passes (cache hits + uploads)",
+    )
+    c(
+        "tpuml_stream_bytes_total",
+        "Bytes uploaded staging row blocks (post-compression, misses only)",
+    )
+    c(
+        "tpuml_stream_upload_seconds_total",
+        "Transfer wall spent uploading row blocks on the prefetch worker",
+    )
+    c(
+        "tpuml_stream_wait_seconds_total",
+        "Wall the streaming consumer spent blocked waiting for a block "
+        "(the NON-hidden share of the transfer wall)",
+    )
+    c(
+        "tpuml_stream_passes_total",
+        "Complete passes over a streamed dataset's block set",
+    )
+    c(
         "tpuml_mesh_reshards_total",
         "Fleet mesh-generation bumps, labeled by reason "
         "(join|death|evict|unsubscribe)",

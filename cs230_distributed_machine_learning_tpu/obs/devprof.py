@@ -43,8 +43,13 @@ from .tracing import _enabled, journal_dir
 
 #: the attribution phases, in pipeline order. ``dispatch`` is the device
 #: execution window minus the blocking fetches it contains — the four
-#: phases sum to (compile + stage + run) wall, not double-counting fetch.
-PHASES = ("stage", "compile", "dispatch", "fetch")
+#: batch phases sum to (compile + stage + run) wall, not double-counting
+#: fetch. ``stream`` is the out-of-core overlap phase: the share of a
+#: streaming pass's host->device transfer wall HIDDEN behind compute by
+#: the double-buffered uploader (data/streaming.py) — the blocking
+#: remainder rides the engine's ordinary ``stage`` accumulator, so
+#: stage + stream together are the full streamed-transfer wall.
+PHASES = ("stage", "compile", "dispatch", "fetch", "stream")
 
 DEVICE_SECONDS = "tpuml_executor_device_seconds_total"
 

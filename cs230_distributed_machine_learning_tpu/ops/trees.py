@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import os
 from functools import partial
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -642,6 +642,212 @@ def build_tree(
         "leaf_val": leaf_val,
         "leaf_weight": Cl,
     }
+
+
+# ---------------- out-of-core streamed builder ----------------
+#
+# build_tree's per-level work is two row reductions (the level histogram
+# and, at the end, the leaf stat sums) plus O(2^depth) node-level math.
+# Both reductions are plain sums over rows, so they block-accumulate: one
+# streamed pass per level (route the pending previous-level split, then
+# add the block's histogram contribution), one final pass for the last
+# routing + leaf sums — depth + 1 passes total, with resident state only
+# the per-sample node ids [n_pad] and stats [n_pad, k+1] (a few bytes per
+# row vs the [n, d] bin matrix). For integer stats (RF classification:
+# one-hot counts, the s8 histogram path) every partial sum is exact, so
+# the streamed tree is BITWISE-identical to build_tree's — the parity
+# tests/test_streaming.py pins split_feat/split_bin/leaf_val equality.
+# Float stats (boosting gradients) match within f32 summation order.
+
+#: jitted per-level block steps, keyed on static geometry so every tree
+#: of every trial re-dispatches the same executables
+_STREAM_TREE_FNS: Dict[Any, Any] = {}
+
+
+def _stream_tree_level_fn(d, k, n_bins, level, precision, count_from_stats):
+    """One block's step of streamed level ``level``: apply the pending
+    previous-level routing to the block's rows, then accumulate the
+    block's contribution to the level histogram (left-children only past
+    the root — the subtraction trick runs AFTER the pass, on the summed
+    histogram, exactly as in build_tree)."""
+    ckey = ("level", d, k, n_bins, level, precision, count_from_stats)
+    fn = _STREAM_TREE_FNS.get(ckey)
+    if fn is not None:
+        return fn
+    n_nodes = 2**level
+    base = n_nodes - 1
+
+    @jax.jit
+    def fn(carry, SC, bf, bb, xb_b, start):
+        node, H = carry
+        rows = xb_b.shape[0]
+        nb = jax.lax.dynamic_slice(node, (start,), (rows,))
+        scb = jax.lax.dynamic_slice(SC, (start, 0), (rows, SC.shape[1]))
+        if level > 0:
+            prev_nodes = n_nodes // 2
+            prev_base = prev_nodes - 1
+            lp = nb - prev_base
+            if prev_nodes <= _LOOKUP_M:
+                go_left = _route_left(xb_b, lp, bf, bb, n_bins)
+            else:
+                go_left = xb_b[jnp.arange(rows), bf[lp]] <= bb[lp]
+            nb = 2 * nb + 1 + jnp.where(go_left, 0, 1)
+            node = jax.lax.dynamic_update_slice(node, nb, (start,))
+        local = nb - base
+        if level == 0:
+            Hb = _hist_with_count(local, xb_b, scb, n_nodes, n_bins,
+                                  precision, k, count_from_stats)
+        else:
+            went_left = (local % 2 == 0).astype(scb.dtype)
+            Hb = _hist_with_count(
+                local // 2, xb_b, scb * went_left[:, None], n_nodes // 2,
+                n_bins, precision, k, count_from_stats,
+            )
+        return node, H + Hb
+
+    _STREAM_TREE_FNS[ckey] = fn
+    return fn
+
+
+def _stream_tree_leaf_fn(d, k, n_bins, depth):
+    """The final streamed pass: apply the last level's pending routing,
+    then accumulate per-leaf stat sums."""
+    ckey = ("leaf", d, k, n_bins, depth)
+    fn = _STREAM_TREE_FNS.get(ckey)
+    if fn is not None:
+        return fn
+    n_internal = 2**depth - 1
+    n_leaves = 2**depth
+    prev_nodes = 2 ** (depth - 1)
+    prev_base = prev_nodes - 1
+
+    @jax.jit
+    def fn(carry, SC, bf, bb, xb_b, start):
+        node, SCl = carry
+        rows = xb_b.shape[0]
+        nb = jax.lax.dynamic_slice(node, (start,), (rows,))
+        scb = jax.lax.dynamic_slice(SC, (start, 0), (rows, SC.shape[1]))
+        lp = nb - prev_base
+        if prev_nodes <= _LOOKUP_M:
+            go_left = _route_left(xb_b, lp, bf, bb, n_bins)
+        else:
+            go_left = xb_b[jnp.arange(rows), bf[lp]] <= bb[lp]
+        nb = 2 * nb + 1 + jnp.where(go_left, 0, 1)
+        node = jax.lax.dynamic_update_slice(node, nb, (start,))
+        leaf_local = nb - n_internal
+        if n_leaves <= _LOOKUP_M:
+            add = _leaf_sums(leaf_local, scb, n_leaves)
+        else:
+            add = jnp.concatenate(
+                [
+                    jax.ops.segment_sum(
+                        scb[:, :k], leaf_local, num_segments=n_leaves
+                    ),
+                    jax.ops.segment_sum(
+                        scb[:, k], leaf_local, num_segments=n_leaves
+                    )[:, None],
+                ],
+                axis=1,
+            )
+        return node, SCl + add
+
+    _STREAM_TREE_FNS[ckey] = fn
+    return fn
+
+
+def build_tree_streamed(
+    stream_pass,
+    S,
+    C,
+    d: int,
+    *,
+    depth: int,
+    n_bins: int,
+    min_samples_leaf: float = 1.0,
+    max_features: Optional[int] = None,
+    key=None,
+    precision=jax.lax.Precision.HIGHEST,
+    count_from_stats: bool = False,
+):
+    """build_tree over streamed row blocks: depth + 1 passes, identical
+    split/leaf math.
+
+    ``stream_pass(fn, carry, *consts)`` must run one ascending pass over
+    the bin-code blocks, folding ``carry = fn(carry, *consts, xb_b,
+    start)`` per block (the kernel drivers wrap a RowBlockStreamer plus
+    the staged-form decode). ``S``/``C`` are the full padded per-sample
+    stats/counts — zero on pad rows, so pads land in node 0's histograms
+    with zero weight and contribute nothing anywhere, exactly like a
+    zero-count sample in build_tree.
+
+    Returns ``(tree, node)`` where ``tree`` matches build_tree's dict and
+    ``node`` is the final per-sample node id array — prediction for the
+    fitting dataset is a resident ``leaf_val[node - n_internal]`` lookup,
+    no extra pass over the data. The per-level random feature subsets
+    consume ``key`` in build_tree's exact split order, so subset draws
+    are bitwise-identical."""
+    if depth < 1:
+        raise ValueError("build_tree_streamed requires depth >= 1")
+    n_pad = S.shape[0]
+    k = S.shape[1]
+    S = S.astype(jnp.float32)
+    C = C.astype(jnp.float32)
+    SC = jnp.concatenate([S, C[:, None]], axis=1)
+    n_internal = 2**depth - 1
+
+    split_feat = jnp.zeros((n_internal,), jnp.int32)
+    split_bin = jnp.full((n_internal,), n_bins - 1, jnp.int32)
+    node = jnp.zeros((n_pad,), jnp.int32)
+
+    H_prev = None
+    bf = jnp.zeros((1,), jnp.int32)
+    bb = jnp.zeros((1,), jnp.int32)
+    for level in range(depth):
+        n_nodes = 2**level
+        base = n_nodes - 1
+        fn = _stream_tree_level_fn(d, k, n_bins, level, precision,
+                                   count_from_stats)
+        H0 = jnp.zeros(
+            (n_nodes if level == 0 else n_nodes // 2, d, n_bins, k + 1),
+            jnp.float32,
+        )
+        node, Hl = stream_pass(fn, (node, H0), SC, bf, bb)
+        if level == 0:
+            H = Hl
+        else:
+            H = jnp.stack([Hl, H_prev - Hl], axis=1).reshape(
+                n_nodes, d, n_bins, k + 1
+            )
+        H_prev = H
+        gain = _split_gain(H, k, n_bins, min_samples_leaf)
+
+        if max_features is not None and max_features < d:
+            key, sub = jax.random.split(key)
+            u = jax.random.uniform(sub, (n_nodes, d))
+            thresh = jnp.sort(u, axis=1)[:, max_features - 1 : max_features]
+            allowed = u <= thresh
+            gain = jnp.where(allowed[:, :, None], gain, -jnp.inf)
+
+        best_gain, bf, bb = _pick_best(gain, n_bins)
+        do_split = best_gain > 1e-7
+        bf = jnp.where(do_split, bf, 0)
+        bb = jnp.where(do_split, bb, n_bins - 1)
+
+        split_feat = jax.lax.dynamic_update_slice(split_feat, bf, (base,))
+        split_bin = jax.lax.dynamic_update_slice(split_bin, bb, (base,))
+
+    leaf_fn = _stream_tree_leaf_fn(d, k, n_bins, depth)
+    SCl0 = jnp.zeros((2**depth, k + 1), jnp.float32)
+    node, SCl = stream_pass(leaf_fn, (node, SCl0), SC, bf, bb)
+    Sl, Cl = SCl[:, :k], SCl[:, k]
+    leaf_val = Sl / jnp.maximum(Cl, _EPS)[:, None]
+    tree = {
+        "split_feat": split_feat,
+        "split_bin": split_bin,
+        "leaf_val": leaf_val,
+        "leaf_weight": Cl,
+    }
+    return tree, node
 
 
 #: features with at most this many bin codes qualify for the deep builder's

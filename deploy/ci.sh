@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip]   (default: fast)
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming]
+#   (default: fast)
 #
 #   fast mode:
 #   1. compileall lint gate — every .py in the package, tests, and
@@ -56,6 +57,16 @@
 #   benchmarks/MULTICHIP_BENCH_r01.json proves the scaling). The nightly
 #   ci.yml job additionally runs the FULL 1/2/4/8 curve and uploads the
 #   fresh MULTICHIP_BENCH JSON for trend-watching.
+#
+#   streaming mode (every push in ci.yml, fast): the out-of-core
+#   row-block streaming suites (tests/test_streaming.py — block-plan
+#   parity, streamed-vs-single-shot score parity incl. the bitwise tree
+#   pin, prefetch pinning, the CS230_STAGE_STRICT OOM repro — plus
+#   tests/test_stage_cache.py, whose acquire/release + overflow-signal
+#   contracts the streamer rides). With STREAMING_FULL=1
+#   (nightly/dispatch) it additionally runs the full-geometry
+#   benchmarks/streaming_micro.py (10x-budget OOM repro + double-buffer
+#   overlap profile) and uploads the fresh STREAMING_MICRO.json.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -203,6 +214,32 @@ elif [ "$MODE" = "multichip" ]; then
     else
       echo "multichip full curve FAILED (see bench-artifacts/multichip_full.log)"
       tail -n 20 bench-artifacts/multichip_full.log
+      rc=1
+    fi
+  fi
+elif [ "$MODE" = "streaming" ]; then
+  echo "== out-of-core streaming suite (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_streaming.py tests/test_stage_cache.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  if [ "${STREAMING_FULL:-0}" = "1" ]; then
+    # nightly/dispatch: the full-geometry OOM repro (10x budget, both
+    # streamed families) + double-buffer overlap profile; the fresh
+    # JSON is uploaded for trend-watching (the committed acceptance
+    # artifact is benchmarks/STREAMING_MICRO.json)
+    echo "== FULL streaming micro-benchmark (OOM repro + overlap) =="
+    mkdir -p bench-artifacts
+    if JAX_PLATFORMS=cpu python benchmarks/streaming_micro.py \
+        > bench-artifacts/streaming_micro.log 2>&1; then
+      cp benchmarks/STREAMING_MICRO.json bench-artifacts/ || true
+      tail -n 3 bench-artifacts/streaming_micro.log
+    else
+      echo "streaming_micro FAILED (see bench-artifacts/streaming_micro.log)"
+      tail -n 20 bench-artifacts/streaming_micro.log
       rc=1
     fi
   fi
