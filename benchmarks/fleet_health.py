@@ -1,0 +1,382 @@
+"""Fleet-health drill: overload -> alert fires -> drain -> alert resolves.
+
+The acceptance drill for the fleet health plane (docs/OBSERVABILITY.md
+"Fleet health plane"), run against a REAL 2-shard fleet (subprocess
+shards + a stateless front end, runtime/fleet.ShardFleet) and observed
+ONLY through the front end — the same path an operator or external
+autoscaler uses:
+
+1. **Flood**: N client threads hammer ``POST /train`` through the front
+   end with admission caps squeezed low, NOT honoring Retry-After — a
+   misbehaving client fleet. 429s pile into
+   ``tpuml_jobs_rejected_total``.
+2. **Fire**: the drill asserts that, fleet-wide via ``GET /autoscale``,
+   ``desired_workers`` rises ABOVE ``live_workers`` (the pressure bump)
+   and that ``GET /alerts`` reports the ``admission_reject_rate``
+   burn-rate alert firing — within ``FIRE_GATE_S`` of the first 429
+   (sweep + ring-sample + evaluation cadences all squeezed for the
+   drill; the committed artifact records the actual latency).
+3. **Drain**: the flood stops; admitted jobs finish through the normal
+   machinery.
+4. **Resolve**: the alert resolves once the short burn window slides
+   clear, and the capacity signal returns to the live count. The whole
+   sequence — ``alert.fire`` then ``alert.resolve``, shard-stamped — is
+   collected by paging the front end's merged ``/events`` feed with its
+   per-shard cursor map, proving the incident is reconstructable from
+   the journaled firehose.
+
+Commits ``benchmarks/FLEET_HEALTH.json``; exits non-zero when any gate
+fails (``deploy/ci.sh obs``).
+
+Run: JAX_PLATFORMS=cpu python benchmarks/fleet_health.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SHARDS = 2
+FLOOD_THREADS = int(os.environ.get("FLEET_HEALTH_FLOOD_THREADS", 6))
+#: hard gate on first-429 -> alert-firing latency (the squeezed cadences
+#: below bound it by sweep 1 s + ring-sample floor 1 s + eval 0.5 s, plus
+#: observation granularity; the artifact records the actual value)
+FIRE_GATE_S = float(os.environ.get("FLEET_HEALTH_FIRE_GATE_S", 10.0))
+#: resolve gate: the 30 s short burn window must slide clear after the
+#: flood stops, plus drain + polling slack
+RESOLVE_GATE_S = float(os.environ.get("FLEET_HEALTH_RESOLVE_GATE_S", 120.0))
+OUT = os.environ.get("FLEET_HEALTH_OUT") or os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "FLEET_HEALTH.json"
+)
+#: shard/front-end subprocess logs; ci.sh points this into its artifact
+#: dir so a red drill uploads them
+LOG_DIR = os.environ.get("FLEET_HEALTH_LOG_DIR")
+
+#: squeezed-for-the-drill cadences and caps (production defaults are
+#: minutes-scale; the *mechanism* is identical)
+DRILL_ENV = {
+    "CS230_OBS": "1",
+    "TPUML_SERVICE__MAX_INFLIGHT_JOBS": "6",
+    "TPUML_SERVICE__MAX_INFLIGHT_JOBS_PER_SESSION": "4",
+    "TPUML_SCHEDULER__SWEEP_INTERVAL_S": "1.0",
+    "TPUML_SERVICE__AUTOSCALE_INTERVAL_S": "0.5",
+    "TPUML_SERVICE__ALERT_EVAL_INTERVAL_S": "0.5",
+    "TPUML_SERVICE__AUTOSCALE_HORIZON_S": "5",
+    "TPUML_SERVICE__AUTOSCALE_DOWNSCALE_HOLD_S": "3",
+    # keep client-side transport retries out of the flood's way
+    "TPUML_SERVICE__ADMISSION_RETRY_AFTER_S": "0.2",
+}
+
+
+def _payload() -> Dict[str, Any]:
+    from sklearn.linear_model import LogisticRegression
+
+    from cs230_distributed_machine_learning_tpu.client.introspection import (
+        extract_model_details,
+    )
+
+    return {
+        "dataset_id": "iris",
+        "model_details": extract_model_details(
+            LogisticRegression(max_iter=50)
+        ),
+        "train_params": {
+            "test_size": 0.2, "random_state": 0, "cv": 2,
+            "search_type": "GridSearchCV",
+            "param_grid": {"C": [0.1, 1.0]},
+        },
+    }
+
+
+def _warm_every_shard(fe: str, payload, n_shards: int) -> None:
+    """One completed job per shard (each has its own executable/dataset
+    caches) so the drain phase is not hostage to cold XLA compiles."""
+    import requests
+
+    warmed = set()
+    for _ in range(32 * n_shards):
+        if len(warmed) == n_shards:
+            return
+        body = requests.post(f"{fe}/create_session", timeout=60).json()
+        k = body.get("shard")
+        if k in warmed:
+            continue
+        sid = body["session_id"]
+        job = requests.post(
+            f"{fe}/train/{sid}", json=payload, timeout=60
+        ).json()
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            st = requests.get(
+                f"{fe}/check_status/{sid}/{job['job_id']}", timeout=60
+            ).json()
+            if st.get("job_status") in (
+                "completed", "failed", "completed_with_failures"
+            ):
+                break
+            time.sleep(0.2)
+        warmed.add(k)
+    raise RuntimeError(f"warmed only shards {sorted(warmed)} of {n_shards}")
+
+
+class _Flood:
+    """Misbehaving clients: submit as fast as possible, never honor
+    Retry-After, count the 429s."""
+
+    def __init__(self, fe: str, payload, n_threads: int):
+        self.fe, self.payload = fe, payload
+        self.stop = threading.Event()
+        self.lock = threading.Lock()
+        self.accepted = 0
+        self.rejected = 0
+        self.first_429_ts: Optional[float] = None
+        self.errors: List[str] = []
+        self.threads = [
+            threading.Thread(target=self._loop, daemon=True)
+            for _ in range(n_threads)
+        ]
+
+    def _loop(self) -> None:
+        import requests
+
+        sess = requests.Session()
+        try:
+            sid = sess.post(
+                f"{self.fe}/create_session", timeout=60
+            ).json()["session_id"]
+            while not self.stop.is_set():
+                r = sess.post(
+                    f"{self.fe}/train/{sid}", json=self.payload, timeout=60
+                )
+                with self.lock:
+                    if r.status_code == 429:
+                        self.rejected += 1
+                        if self.first_429_ts is None:
+                            self.first_429_ts = time.time()
+                    elif r.ok:
+                        self.accepted += 1
+                time.sleep(0.02)
+        except Exception as e:  # noqa: BLE001 — one flooder dying is data
+            with self.lock:
+                self.errors.append(f"{type(e).__name__}: {e}")
+
+    def start(self) -> None:
+        for t in self.threads:
+            t.start()
+
+    def halt(self) -> None:
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=30)
+
+
+def _get(url: str, timeout: float = 10):
+    import requests
+
+    r = requests.get(url, timeout=timeout)
+    r.raise_for_status()
+    return r.json()
+
+
+def _firing_rules(alerts_body) -> List[str]:
+    return sorted({f["rule"] for f in alerts_body.get("firing") or []})
+
+
+def _collect_alert_events(fe: str) -> List[Dict[str, Any]]:
+    """Page the front end's merged /events by its per-shard cursor map;
+    keep the alert.* events (shard-stamped by the merge)."""
+    out: List[Dict[str, Any]] = []
+    cursor = ""
+    for _ in range(64):
+        url = f"{fe}/events?limit=1000"
+        if cursor:
+            from urllib.parse import quote
+
+            url += f"&since={quote(cursor)}"
+        body = _get(url)
+        if not body["events"]:
+            break
+        for e in body["events"]:
+            if str(e.get("kind", "")).startswith("alert."):
+                out.append({
+                    "kind": e["kind"], "shard": e.get("shard"),
+                    "seq": e.get("seq"), "ts": e.get("ts"),
+                    "rule": (e.get("data") or {}).get("rule"),
+                    "value": (e.get("data") or {}).get("value"),
+                })
+        cursor = body["cursor"]
+    return out
+
+
+def run() -> Dict[str, Any]:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.fleet import (
+        ShardFleet,
+    )
+    from cs230_distributed_machine_learning_tpu.utils.config import (
+        get_config,
+    )
+
+    materialize_builtin("iris")
+    root = get_config().storage.root
+    fleet = ShardFleet(
+        SHARDS,
+        storage_root=root,
+        n_frontends=1,
+        local_executors=1,
+        journal=True,  # alert.fire/resolve must land in events.jsonl
+        env=dict(DRILL_ENV),
+        log_dir=LOG_DIR or os.path.join(root, "fleet-health-logs"),
+    )
+    payload = _payload()
+    gates: Dict[str, bool] = {}
+    timeline: Dict[str, Any] = {}
+    try:
+        fleet.start()
+        fe = fleet.frontend_urls[0]
+        _warm_every_shard(fe, payload, SHARDS)
+
+        baseline = _get(f"{fe}/autoscale")
+        live = baseline["live_workers"]
+        assert live == SHARDS, f"expected {SHARDS} live workers, got {live}"
+
+        # ---- phase 1+2: flood until the plane reacts ----
+        flood = _Flood(fe, payload, FLOOD_THREADS)
+        t_flood = time.time()
+        flood.start()
+        fired_at = scaled_at = None
+        peak_scale = None
+        deadline = t_flood + 60
+        while time.time() < deadline and (
+            fired_at is None or scaled_at is None
+        ):
+            scale = _get(f"{fe}/autoscale")
+            alerts = _get(f"{fe}/alerts")
+            if scaled_at is None and (
+                scale["desired_workers"] > scale["live_workers"]
+            ):
+                scaled_at, peak_scale = time.time(), scale
+            if fired_at is None and (
+                "admission_reject_rate" in _firing_rules(alerts)
+            ):
+                fired_at = time.time()
+            time.sleep(0.15)
+        first_429 = flood.first_429_ts
+        gates["admission_alert_fired"] = fired_at is not None
+        gates["desired_workers_above_live"] = scaled_at is not None
+        fire_latency = (
+            None if (fired_at is None or first_429 is None)
+            else round(fired_at - first_429, 3)
+        )
+        gates["fire_latency_within_gate"] = (
+            fire_latency is not None and fire_latency <= FIRE_GATE_S
+        )
+
+        # ---- phase 3+4: drain and watch it resolve ----
+        flood.halt()
+        t_stop = time.time()
+        resolved_at = None
+        deadline = t_stop + RESOLVE_GATE_S
+        while time.time() < deadline:
+            alerts = _get(f"{fe}/alerts")
+            scale = _get(f"{fe}/autoscale")
+            if (
+                "admission_reject_rate" not in _firing_rules(alerts)
+                and scale["desired_workers"] <= scale["live_workers"]
+            ):
+                resolved_at = time.time()
+                break
+            time.sleep(0.5)
+        gates["alert_resolved_after_drain"] = resolved_at is not None
+        final_scale = _get(f"{fe}/autoscale")
+        final_alerts = _get(f"{fe}/alerts")
+
+        alert_events = _collect_alert_events(fe)
+        fire_evs = [e for e in alert_events
+                    if e["kind"] == "alert.fire"
+                    and e["rule"] == "admission_reject_rate"]
+        res_evs = [e for e in alert_events
+                   if e["kind"] == "alert.resolve"
+                   and e["rule"] == "admission_reject_rate"]
+        gates["fire_and_resolve_journaled"] = bool(fire_evs and res_evs)
+        gates["events_shard_stamped"] = all(
+            e["shard"] in range(SHARDS) for e in alert_events
+        )
+        gates["flood_saw_429s"] = flood.rejected > 0
+        gates["flood_saw_accepts"] = flood.accepted > 0
+
+        timeline = {
+            "flood_threads": FLOOD_THREADS,
+            "accepted_submits": flood.accepted,
+            "rejected_429s": flood.rejected,
+            "flood_errors": flood.errors,
+            "first_429_after_flood_start_s": (
+                None if first_429 is None else round(first_429 - t_flood, 3)
+            ),
+            "alert_fire_after_first_429_s": fire_latency,
+            "fire_gate_s": FIRE_GATE_S,
+            "desired_above_live_after_flood_start_s": (
+                None if scaled_at is None else round(scaled_at - t_flood, 3)
+            ),
+            "resolve_after_flood_stop_s": (
+                None if resolved_at is None
+                else round(resolved_at - t_stop, 3)
+            ),
+            "resolve_gate_s": RESOLVE_GATE_S,
+        }
+        out = {
+            "benchmark": "fleet_health_drill",
+            "config": {
+                "shards": SHARDS,
+                "frontends": 1,
+                "executors_per_shard": 1,
+                "drill_env": DRILL_ENV,
+                "job_shape":
+                    "iris LogisticRegression GridSearchCV 2 trials cv=2",
+            },
+            "backend": "cpu",
+            "timeline": timeline,
+            "autoscale": {
+                "baseline": baseline,
+                "at_peak": peak_scale,
+                "final": final_scale,
+            },
+            "alerts_final": {
+                "status": final_alerts["status"],
+                "firing": final_alerts["firing"],
+            },
+            "alert_events": alert_events,
+            "gates": gates,
+            "passed": all(gates.values()),
+            "ts": time.time(),
+        }
+    finally:
+        fleet.stop()
+    return out
+
+
+def main() -> int:
+    out = run()
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(out["gates"], indent=2))
+    print(f"wrote {OUT}")
+    if not out["passed"]:
+        print("FLEET HEALTH DRILL FAILED", file=sys.stderr)
+        return 1
+    print("fleet health drill passed: overload -> fire -> drain -> resolve")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,12 @@ from .recorder import (  # noqa: F401 — re-exported API
     FlightRecorder,
     record_event,
 )
+from .signals import CapacitySignals  # noqa: F401 — re-exported API
+from .slo import (  # noqa: F401 — re-exported API
+    AlertEngine,
+    AlertRule,
+    default_rules,
+)
 from .timeseries import (  # noqa: F401 — re-exported API
     TIMESERIES,
     TimeSeriesStore,
@@ -490,6 +496,40 @@ def register_catalog() -> None:
         "Delivery lag of the most recent SSE progress event beyond the "
         "stream's tick cadence (seconds a subscriber saw its event late)",
     )
+    # ---- fleet health plane (docs/OBSERVABILITY.md "Fleet health
+    # plane") ----
+    g(
+        "tpuml_autoscale_desired_workers",
+        "Capacity signal: workers this coordinator should run, derived "
+        "from predictor-priced backlog + admission/latency pressure with "
+        "scale-down hysteresis (obs/signals.py; GET /autoscale)",
+    )
+    g(
+        "tpuml_autoscale_desired_shards",
+        "Capacity signal: coordinator shards the fleet should run, sized "
+        "to autoscale_target_fill of the carved admission caps "
+        "(obs/signals.py; GET /autoscale)",
+    )
+    g(
+        "tpuml_autoscale_backlog_seconds",
+        "Predictor-priced backlog the capacity deriver last folded: "
+        "queued load books plus unplaced pending subtasks at the mean "
+        "queued estimate (seconds)",
+    )
+    g(
+        "tpuml_alert_firing",
+        "1 while an alert rule is firing, 0 once resolved, labeled by "
+        "rule (obs/slo.py; GET /alerts)",
+    )
+    c(
+        "tpuml_alerts_fired_total",
+        "alert.fire transitions of the SLO rules engine, labeled by rule",
+    )
+    c(
+        "tpuml_alerts_resolved_total",
+        "alert.resolve transitions of the SLO rules engine, labeled by "
+        "rule",
+    )
 
 
 register_catalog()
@@ -521,6 +561,10 @@ __all__ = [
     "TIMESERIES",
     "TimeSeriesStore",
     "timeseries_sample",
+    "CapacitySignals",
+    "AlertEngine",
+    "AlertRule",
+    "default_rules",
     "TRACER",
     "Tracer",
     "TRACE_HEADER",

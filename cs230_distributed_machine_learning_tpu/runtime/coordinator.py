@@ -107,6 +107,18 @@ class Coordinator:
         self.ready = not journal
         #: recovery forensics for /healthz (replayed-op counts, wall time)
         self.recovery: Dict[str, Any] = {}
+        # fleet health plane (docs/OBSERVABILITY.md "Fleet health
+        # plane"): capacity-signal deriver (GET /autoscale) + SLO alert
+        # rules engine (GET /alerts), evaluated on the engine sweep in
+        # cluster mode and at scrape//read time in direct mode
+        from ..obs.signals import CapacitySignals
+        from ..obs.slo import AlertEngine, default_rules
+
+        self.signals = CapacitySignals(self)
+        self.alerts = AlertEngine(
+            default_rules(self.config),
+            interval_s=self.config.service.alert_eval_interval_s,
+        )
         if cluster is not None:
             # journal every attempt issue (lease reclaim / retry / requeue /
             # speculation) into the job store so replay preserves budgets,
@@ -121,8 +133,24 @@ class Coordinator:
             cluster.engine.on_mesh_change = self._journal_mesh_change
             # overload probe: speculation sheds first under load
             cluster.engine.shed_check = self.overload_shedding
+            cluster.engine.on_sweep_end = self.health_tick
         if journal:
             self._recover()
+
+    def health_tick(self, force: bool = False) -> None:
+        """One fleet-health evaluation: derive the capacity signals and
+        run the alert rules. Driven by the engine sweep (cluster mode),
+        every ``/metrics/prom`` scrape, and ``/alerts`` / ``/autoscale``
+        reads (direct-mode coordinators have no sweep) — both halves are
+        internally throttled so the drivers don't multi-evaluate."""
+        try:
+            self.signals.evaluate(force=force)
+        except Exception:  # noqa: BLE001 — health derivation must never break a caller
+            logger.exception("Capacity-signal derivation failed")
+        try:
+            self.alerts.evaluate(force=force)
+        except Exception:  # noqa: BLE001
+            logger.exception("Alert-rule evaluation failed")
 
     def _recover(self) -> None:
         """Boot-time crash recovery: surface the journal replay the store

@@ -18,8 +18,13 @@ routed to a coordinator shard using only the ids already in the URL
   pins it, else round-robin) — the shard's engine mints the stamped id;
 - fleet-wide concerns aggregate over every shard: ``/healthz`` (worst
   status wins), ``/readyz`` (ready only when EVERY shard is), ``/jobs``
-  / ``/workers`` / ``/queues`` (merged), and ``/metrics/prom`` (one
-  exposition with a ``shard`` label injected per series).
+  / ``/workers`` / ``/queues`` (merged), ``/metrics/prom`` (one
+  exposition with a ``shard`` label injected per series),
+  ``/metrics/history`` (scatter-merge by series, shard-labeled),
+  ``/events`` (seq-ordered merge paged by PER-SHARD cursors),
+  ``/alerts`` (union of every shard's rule states, shard-stamped), and
+  ``/autoscale`` (fleet-summed capacity signals with per-shard bodies)
+  — the fleet health plane, docs/OBSERVABILITY.md.
 
 Because no state lives here, any number of front ends can run against
 the same shard fleet, restart freely, and serve any client: a job
@@ -385,17 +390,130 @@ def create_frontend_app(shard_urls: List[str]):
     # merge into the same shapes (not the raw {"shards": ...} scatter)
 
     def _events(request):
+        """Fleet event feed: seq-ordered merge with PER-SHARD cursors.
+
+        Per-shard seqs collide (every recorder counts from 1), so one
+        fleet-wide ``last_seq`` cannot page this feed. Instead ``?since=``
+        accepts either a plain int (applied to every shard — the
+        single-coordinator contract, so direct-mode pollers keep working)
+        or the JSON cursor map a previous response returned
+        (``{"0": 41, "1": 17}``); the response carries ``cursors`` (the
+        map) and ``cursor`` (its compact JSON encoding, ready to pass
+        back url-encoded). Events merge sorted by (seq, shard) — a
+        deterministic interleave in which each shard's events stay in
+        its own seq order — truncated to ``?limit=`` from the OLDEST end,
+        so repeated cursor polls walk forward without ever duplicating
+        or skipping a (shard, seq) pair across page boundaries (pinned
+        in tests/test_frontend_aggregation.py)."""
+        def _int(v, default):
+            try:
+                return int(v)
+            except (TypeError, ValueError):
+                return default
+
+        limit = max(_int(request.args.get("limit"), 1000), 1)
+        cursors = {k: 0 for k in range(n_shards)}
+        since_raw = request.args.get("since") or ""
+        if since_raw:
+            parsed = None
+            try:
+                parsed = json.loads(since_raw)
+            except ValueError:
+                pass
+            if isinstance(parsed, dict):
+                for k, v in parsed.items():
+                    kk = _int(k, -1)
+                    if 0 <= kk < n_shards:
+                        cursors[kk] = _int(v, 0)
+            else:
+                base = _int(since_raw, 0)
+                cursors = {k: base for k in range(n_shards)}
+
+        def _one(k: int):
+            try:
+                r = session.get(
+                    f"{urls[k]}/events",
+                    params={"since": cursors[k], "limit": limit},
+                    timeout=10,
+                )
+                return k, (r.json() if r.ok else None)
+            except requests.RequestException:
+                return k, None
+
         merged: List[Dict[str, Any]] = []
-        for k, body in _fan_json(request, request.path).items():
+        for k, body in fan_pool.map(_one, range(n_shards)):
             for e in (body or {}).get("events") or []:
                 e["shard"] = k
                 merged.append(e)
-        # per-shard seqs collide, so order by wall clock; last_seq is
-        # meaningless fleet-wide (pollers should cursor per shard)
-        merged.sort(key=lambda e: e.get("ts") or 0)
-        return _json(
-            {"events": merged, "n_events": len(merged), "last_seq": 0}
+        merged.sort(
+            key=lambda e: (int(e.get("seq") or 0), int(e.get("shard") or 0))
         )
+        merged = merged[:limit]
+        # advance each shard's cursor to its newest RETURNED seq; the
+        # sort/truncate keeps a per-shard seq prefix, so max == last
+        out_cursors = dict(cursors)
+        for e in merged:
+            k = e["shard"]
+            out_cursors[k] = max(out_cursors[k], int(e.get("seq") or 0))
+        cursor_map = {str(k): v for k, v in sorted(out_cursors.items())}
+        return _json({
+            "events": merged,
+            "n_events": len(merged),
+            "cursors": cursor_map,
+            "cursor": json.dumps(cursor_map, separators=(",", ":")),
+            # legacy field: per-shard seqs collide, use `cursor` to page
+            "last_seq": 0,
+        })
+
+    def _alerts(request):
+        """Fleet alert view: the union of every shard's rule states,
+        each entry stamped with its shard (the same rule can fire on one
+        shard and be quiet on another — attribution is the point)."""
+        shards = _fan_json(request, request.path)
+        merged: List[Dict[str, Any]] = []
+        for k in sorted(shards):
+            for a in (shards[k] or {}).get("alerts") or []:
+                a = dict(a)
+                a["shard"] = k
+                merged.append(a)
+        merged.sort(key=lambda a: (a.get("rule") or "", a.get("shard") or 0))
+        firing = [
+            {"rule": a["rule"], "shard": a["shard"]}
+            for a in merged if a.get("state") == "firing"
+        ]
+        return _json({
+            "status": "firing" if firing else "ok",
+            "n_firing": len(firing),
+            "firing": firing,
+            "alerts": merged,
+            "n_shards": n_shards,
+            "shards_down": [k for k in range(n_shards) if k not in shards],
+        })
+
+    def _autoscale(request):
+        """Fleet capacity view: desired/live workers SUM across shards
+        (each shard owns its worker pool, so fleet capacity is the sum),
+        desired_shards is the MAX of the per-shard recommendations (each
+        shard sizes the whole fleet from its own saturation — the most
+        pressured shard's view wins), with the per-shard bodies attached
+        for attribution."""
+        shards = _fan_json(request, request.path)
+        bodies = {k: (shards[k] or {}) for k in shards}
+        return _json({
+            "desired_workers": sum(
+                int(b.get("desired_workers") or 0) for b in bodies.values()
+            ),
+            "live_workers": sum(
+                int(b.get("live_workers") or 0) for b in bodies.values()
+            ),
+            "desired_shards": max(
+                [int(b.get("desired_shards") or 0) for b in bodies.values()]
+                + [0]
+            ),
+            "n_shards": n_shards,
+            "shards_down": [k for k in range(n_shards) if k not in shards],
+            "shards": bodies,
+        })
 
     def _metrics_history(request):
         shards = _fan_json(request, request.path)
@@ -529,6 +647,10 @@ def create_frontend_app(shard_urls: List[str]):
             return _dashboard(request)
         if head == "events":
             return _events(request)
+        if head == "alerts":
+            return _alerts(request)
+        if head == "autoscale":
+            return _autoscale(request)
         if head == "supervisor":
             return _supervisor(request)
         if head == "metrics" and len(parts) == 2 and parts[1] == "history":

@@ -179,6 +179,11 @@ class PlacementEngine:
         self.on_mesh_change: Optional[
             Callable[[int, str, Dict[str, Any]], None]
         ] = None
+        #: called at the end of every sweep, after the health/route-p99
+        #: refresh and the time-series sample — the coordinator hooks its
+        #: fleet-health tick here (capacity signals + alert evaluation,
+        #: docs/OBSERVABILITY.md "Fleet health plane")
+        self.on_sweep_end: Optional[Callable[[], None]] = None
         self._lock = threading.RLock()
         self.workers: Dict[str, WorkerState] = {}
         self._next_id = 0
@@ -984,6 +989,14 @@ class PlacementEngine:
         # coordinators nothing ever scrapes (dashboard-only deployments).
         refresh_route_p99()
         timeseries_sample()
+        # fleet-health tick rides the same cadence, AFTER the sample so
+        # the alert rules see this sweep's datapoints
+        hook = self.on_sweep_end
+        if hook is not None:
+            try:
+                hook()
+            except Exception:  # noqa: BLE001 — health derivation must not break the sweep
+                logger.exception("on_sweep_end hook failed")
         return [w.worker_id for w in dead]
 
     def _speculate(self) -> List[Dict[str, Any]]:

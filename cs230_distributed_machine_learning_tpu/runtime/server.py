@@ -54,7 +54,8 @@ _DASHBOARD_HTML = """<!doctype html>
  · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code>
  <code>/metrics/prom</code> <code>/metrics/history?name=</code> <code>/trace/&lt;job_id&gt;</code>
  <code>/cost/&lt;job_id&gt;</code> <code>/explain/&lt;job_id&gt;/&lt;subtask_id&gt;</code>
- <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code></div>
+ <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code>
+ <code>/alerts</code> <code>/autoscale</code></div>
 <h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
 <th>status</th><th>done</th><th>failed</th><th>pruned</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
 <h2>Latest job trace</h2>
@@ -65,6 +66,9 @@ _DASHBOARD_HTML = """<!doctype html>
 <div id="spark" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no samples yet</div>
 <h2>Perf observatory</h2>
 <div id="perfspark" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no samples yet</div>
+<h2>Fleet health</h2>
+<div id="autoscale" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no signals yet</div>
+<table id="alerts"><thead></thead><tbody></tbody></table>
 <h2>Flight recorder (latest events)</h2>
 <table id="events"><thead></thead><tbody></tbody></table>
 <h2>Workers</h2><table id="workers"><thead></thead><tbody></tbody></table>
@@ -208,6 +212,30 @@ async function renderSparks(el, sparks){
   const html = blocks.filter(Boolean).join("");
   el.innerHTML = html || "no samples yet";
 }
+// fleet health panel (docs/OBSERVABILITY.md "Fleet health plane"):
+// the derived capacity signals + per-rule alert states
+function renderHealth(scaleEl, alertsEl, sc, al){
+  if (sc && sc.desired_workers != null){
+    const held = sc.hysteresis && sc.hysteresis.scale_down_held;
+    const sig = sc.signals || {};
+    scaleEl.innerHTML =
+      `desired workers <b>${esc(sc.desired_workers)}</b> (live ${esc(sc.live_workers)})` +
+      ` \\u00b7 desired shards <b>${esc(sc.desired_shards)}</b> (now ${esc(sc.n_shards)})` +
+      (held ? ` \\u00b7 <span class="bad">scale-down held (drain)</span>` : "") +
+      `<div style="color:#666">backlog ${esc(sig.backlog_seconds)} s \\u00b7 ` +
+      `inflight ${esc(sig.inflight_jobs)} jobs / ${esc(sig.pending_subtasks)} subtasks \\u00b7 ` +
+      `admission ${esc(((sig.admission_utilization || 0) * 100).toFixed(0))}% \\u00b7 ` +
+      `p99 ${esc(sig.route_p99_s)} s \\u00b7 pressure ${esc(sig.pressure)}</div>`;
+  } else scaleEl.textContent = "no signals yet";
+  const rows = ((al && al.alerts) || []).map(a => ({
+    rule: a.rule,
+    state: a.state === "firing" ? "\\u25cf firing" : a.state,
+    value: a.value == null ? "\\u2013" : (+a.value).toPrecision(3),
+    threshold: `${a.cmp} ${a.threshold}`, severity: a.severity,
+    since: a.for_s == null ? "" : `${a.for_s.toFixed(0)}s`,
+  }));
+  listTable(alertsEl, rows);
+}
 // flight-recorder feed: the newest events, newest first
 async function renderEvents(el, ev){
   const rows = ((ev && ev.events) || []).slice(-15).reverse().map(e => ({
@@ -223,9 +251,9 @@ async function tick(){
   // drives the time-series sampler even on direct-mode coordinators that
   // have no sweep loop and no external Prometheus
   fetch("/metrics/prom").catch(() => {});
-  const [h, jobs, workers, queues, sup, ev] = await Promise.all(
+  const [h, jobs, workers, queues, sup, ev, al, sc] = await Promise.all(
     ["/health", "/jobs", "/workers", "/queues", "/supervisor",
-     "/events?limit=500"].map(get));
+     "/events?limit=500", "/alerts", "/autoscale"].map(get));
   const he = document.getElementById("health");
   he.textContent = h ? h.status : "unreachable";
   he.className = h && h.status === "ok" ? "ok" : "bad";
@@ -241,6 +269,8 @@ async function tick(){
   kvTable(document.getElementById("queues"), queues);
   listTable(document.getElementById("sup"), sup);
   renderEvents(document.getElementById("events"), ev);
+  renderHealth(document.getElementById("autoscale"),
+               document.getElementById("alerts"), sc, al);
   await renderSparks(document.getElementById("spark"), SPARKS);
   await renderSparks(document.getElementById("perfspark"), PERF_SPARKS);
   const latest = Array.isArray(jobs) && jobs.length ? jobs[0].job_id : null;
@@ -311,6 +341,11 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/explain/<jid>/<stid>", endpoint="explain", methods=["GET"]),
             Rule("/explain/<jid>", endpoint="explain_job", methods=["GET"]),
             Rule("/events", endpoint="events", methods=["GET"]),
+            # fleet health plane (docs/OBSERVABILITY.md "Fleet health
+            # plane"): SLO alert states and the derived capacity signals
+            # an external autoscaler acts on
+            Rule("/alerts", endpoint="alerts", methods=["GET"]),
+            Rule("/autoscale", endpoint="autoscale", methods=["GET"]),
             Rule("/metrics/history", endpoint="metrics_history",
                  methods=["GET"]),
             Rule("/predictor/calibration", endpoint="predictor_calibration",
@@ -575,6 +610,10 @@ def create_app(coordinator: Optional[Coordinator] = None):
         # the sweep is the other driver) — direct-mode coordinators have
         # no sweep loop, so history still accumulates at scrape cadence
         timeseries_sample()
+        # ... and drives the fleet-health tick (capacity signals + alert
+        # rules, throttled) for the same no-sweep reason, so the
+        # autoscale/alert gauges in THIS exposition are current
+        coord.health_tick()
         return Response(
             render_prometheus(),
             content_type="text/plain; version=0.0.4; charset=utf-8",
@@ -753,6 +792,29 @@ def create_app(coordinator: Optional[Coordinator] = None):
         limit = _int_arg("limit", 1000)
         evts, last = RECORDER.events(since=since, limit=limit)
         return _json({"events": evts, "n_events": len(evts), "last_seq": last})
+
+    def alerts(request):
+        """Fleet-health alert states (obs/slo.py): one entry per rule
+        with its live ok/pending/firing state. Reading evaluates the
+        rules first (throttled; ``?force=1`` bypasses the floor), so a
+        poller never sees a state staler than the evaluation interval —
+        direct-mode coordinators have no sweep to keep it fresh."""
+        coord.health_tick(force=bool(request.args.get("force")))
+        out = coord.alerts.snapshot()
+        if coord.shard_id is not None:
+            out["shard"] = coord.shard_id
+        return _json(out)
+
+    def autoscale(request):
+        """Derived capacity signals (obs/signals.py): the
+        desired_workers/desired_shards an external autoscaler acts on,
+        with the raw signals and the hysteresis verdict that produced
+        them. Evaluates first like /alerts."""
+        coord.health_tick(force=bool(request.args.get("force")))
+        out = dict(coord.signals.report())
+        if coord.shard_id is not None:
+            out["shard"] = coord.shard_id
+        return _json(out)
 
     def metrics_history(request):
         """Embedded time-series read (obs/timeseries.py): ?name= selects a

@@ -160,6 +160,30 @@ class ServiceConfig:
     # retrying an idempotent request through 429/503/connection errors
     # (capped jittered backoff, Retry-After honored). 0 disables retries.
     request_retry_s: float = 60.0
+    # ---- fleet health plane (docs/OBSERVABILITY.md "Fleet health
+    # plane"): capacity signals (obs/signals.py) + SLO alert rules
+    # (obs/slo.py) ----
+    # evaluation floors: the engine sweep, /metrics/prom scrapes, and
+    # /alerts //autoscale reads all drive evaluation — the throttle keeps
+    # the drivers from multi-evaluating
+    autoscale_interval_s: float = 5.0
+    alert_eval_interval_s: float = 5.0
+    # drain-time target: desired_workers is sized so the predictor-priced
+    # backlog drains within this horizon (also the rejection-rate window
+    # of the pressure probe)
+    autoscale_horizon_s: float = 120.0
+    autoscale_min_workers: int = 1
+    autoscale_max_workers: int = 256
+    # desired_shards targets this fill fraction of the admission caps
+    autoscale_target_fill: float = 0.7
+    # scale-down hysteresis: a below-live signal must hold this long (and
+    # idle workers must exist to drain through the lease/evict path)
+    # before the published gauge actually drops
+    autoscale_downscale_hold_s: float = 180.0
+    # SLO targets the default alert rules evaluate (obs/slo.py)
+    route_p99_slo_s: float = 2.0
+    sse_lag_slo_s: float = 5.0
+    alert_admission_reject_per_s: float = 0.2
 
 
 @dataclasses.dataclass

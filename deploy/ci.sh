@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming]
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs]
 #   (default: fast)
 #
 #   fast mode:
@@ -67,6 +67,19 @@
 #   (nightly/dispatch) it additionally runs the full-geometry
 #   benchmarks/streaming_micro.py (10x-budget OOM repro + double-buffer
 #   overlap profile) and uploads the fresh STREAMING_MICRO.json.
+#
+#   obs mode (every push in ci.yml, fast): the fleet-health-plane gate
+#   (docs/OBSERVABILITY.md "Fleet health plane") — the alert-engine /
+#   capacity-signal unit suites (tests/test_fleet_health.py: burn-rate
+#   windows, counter-reset clamping, hysteresis/drain gating, the pinned
+#   stage_cache_overflow fire), the front-end aggregation suites
+#   (tests/test_frontend_aggregation.py: merged Prometheus exposition,
+#   /events cursor paging, /alerts union, /autoscale sums against fake
+#   shards), and the flight-recorder metric/event catalog parity gates —
+#   then the live overload→fire→drain→resolve drill
+#   (benchmarks/fleet_health.py) on a real 2-shard fleet through the
+#   front end, refreshing FLEET_HEALTH.json into bench-artifacts/ (the
+#   committed acceptance artifact is benchmarks/FLEET_HEALTH.json).
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -242,6 +255,33 @@ elif [ "$MODE" = "streaming" ]; then
       tail -n 20 bench-artifacts/streaming_micro.log
       rc=1
     fi
+  fi
+elif [ "$MODE" = "obs" ]; then
+  echo "== fleet health plane suites (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_fleet_health.py tests/test_frontend_aggregation.py \
+    tests/test_flight_recorder.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  # live 2-shard overload→fire→drain→resolve drill through the front
+  # end; measures fresh and gates on the 8 functional assertions (alert
+  # fired, desired>live, journaled fire+resolve, shard attribution, …).
+  # Fresh JSON goes to bench-artifacts/ for trend-watching; the shard
+  # subprocess logs land under $ART_DIR so a red drill uploads them.
+  echo "== fleet health drill (2 shards, overload→fire→drain→resolve) =="
+  mkdir -p bench-artifacts
+  if FLEET_HEALTH_OUT=bench-artifacts/FLEET_HEALTH.json \
+      FLEET_HEALTH_LOG_DIR="$ART_DIR/fleet-health-logs" \
+      JAX_PLATFORMS=cpu python benchmarks/fleet_health.py \
+      > bench-artifacts/fleet_health.log 2>&1; then
+    tail -n 3 bench-artifacts/fleet_health.log
+  else
+    echo "fleet_health drill FAILED (see bench-artifacts/fleet_health.log)"
+    tail -n 20 bench-artifacts/fleet_health.log
+    rc=1
   fi
 elif [ "$MODE" = "loadtest" ]; then
   # full sharded control-plane load test (nightly/dispatch in ci.yml):

@@ -5,6 +5,7 @@
 #
 #   deploy/launch_fleet.sh up [N_AGENTS=2] [PORT=5001]   # start + health-wait
 #   deploy/launch_fleet.sh demo                          # run the titanic demo
+#   deploy/launch_fleet.sh status                        # health plane snapshot
 #   deploy/launch_fleet.sh down                          # stop everything
 #
 # State (pids/logs) lives in .fleet/ under the repo root.
@@ -50,6 +51,28 @@ demo() {
   (cd "$REPO" && PYTHONPATH="$REPO" "$PY" examples/demo_end_to_end.py --url "$URL")
 }
 
+# health-plane snapshot (docs/OBSERVABILITY.md "Fleet health plane"):
+# firing alerts + the capacity signal an external autoscaler would read
+status() {
+  curl -fsS "$URL/alerts" | "$PY" -c '
+import json, sys
+b = json.load(sys.stdin)
+firing = b.get("firing") or []
+msg = "alerts: " + str(b["status"])
+if firing:
+    msg += " (%d firing: %s)" % (len(firing), firing)
+print(msg)
+'
+  curl -fsS "$URL/autoscale" | "$PY" -c '
+import json, sys
+b = json.load(sys.stdin)
+s = b["signals"]
+print("autoscale: desired_workers=%s live_workers=%s backlog_s=%s pressure=%s"
+      % (b["desired_workers"], b["live_workers"],
+         s["backlog_seconds"], s["pressure"]))
+'
+}
+
 down() {
   for f in "$STATE"/*.pid; do
     [ -e "$f" ] || continue
@@ -64,7 +87,8 @@ down() {
 
 case "${1:-up}" in
   up)    PORT="${3:-$PORT}"; URL="http://127.0.0.1:${PORT}"; up "${2:-2}" ;;
-  demo)  PORT="${2:-$PORT}"; URL="http://127.0.0.1:${PORT}"; demo ;;
-  down)  PORT="${2:-$PORT}"; URL="http://127.0.0.1:${PORT}"; down ;;
-  *) echo "usage: $0 {up [n_agents] [port]|demo [port]|down [port]}"; exit 2 ;;
+  demo)   PORT="${2:-$PORT}"; URL="http://127.0.0.1:${PORT}"; demo ;;
+  status) PORT="${2:-$PORT}"; URL="http://127.0.0.1:${PORT}"; status ;;
+  down)   PORT="${2:-$PORT}"; URL="http://127.0.0.1:${PORT}"; down ;;
+  *) echo "usage: $0 {up [n_agents] [port]|demo [port]|status [port]|down [port]}"; exit 2 ;;
 esac

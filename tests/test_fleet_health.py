@@ -1,0 +1,434 @@
+"""Fleet health plane (docs/OBSERVABILITY.md "Fleet health plane"):
+ring-window primitives, the alert-rule state machine (threshold /
+multi-window burn rate / increase), the pinned stage-cache-overflow
+rule, capacity-signal derivation with scale-down hysteresis, and the
+direct-mode /alerts //autoscale endpoints."""
+
+import math
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from cs230_distributed_machine_learning_tpu.obs import (
+    RECORDER,
+    REGISTRY,
+    AlertEngine,
+    AlertRule,
+    CapacitySignals,
+    default_rules,
+    timeseries_sample,
+)
+from cs230_distributed_machine_learning_tpu.obs.slo import (
+    latest_value,
+    windowed_increase,
+    windowed_rate,
+)
+from cs230_distributed_machine_learning_tpu.obs.timeseries import TimeSeriesStore
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+from cs230_distributed_machine_learning_tpu.utils.config import FrameworkConfig
+
+
+NOW = 1_700_000_000.0
+
+
+def _store(*series):
+    """Build a private TimeSeriesStore from (name, labels, [(ts, v)...])."""
+    st = TimeSeriesStore()
+    for name, labels, samples in series:
+        for ts, v in samples:
+            st._append(name, labels, ts, v)
+    return st
+
+
+# ---------------- ring-window primitives ----------------
+
+
+def test_windowed_increase_reset_clamped():
+    # counter climbs 5 -> 8, restarts to 2: increase = 3 + 2, never negative
+    st = _store(("c", {}, [(NOW - 50, 5.0), (NOW - 30, 8.0), (NOW - 10, 2.0)]))
+    inc, cov = windowed_increase("c", 40.0, now=NOW, store=st)
+    assert inc == pytest.approx(5.0)
+    assert cov == pytest.approx(40.0)  # baseline sample pre-dates the window
+
+
+def test_windowed_increase_implied_zero_baseline():
+    # a series born inside the window starts from zero (counters are born
+    # at zero) and its coverage is the REAL elapsed span, not the window
+    st = _store(("c", {}, [(NOW - 5, 4.0)]))
+    inc, cov = windowed_increase("c", 300.0, now=NOW, store=st)
+    assert inc == pytest.approx(4.0)
+    assert cov == pytest.approx(5.0)
+    # rate over real coverage (floored at 1 s): a flood that JUST started
+    # fires fast instead of being diluted across the empty window
+    assert windowed_rate("c", 300.0, now=NOW, store=st) == pytest.approx(0.8)
+
+
+def test_windowed_increase_no_data():
+    st = _store()
+    assert windowed_increase("c", 60.0, now=NOW, store=st) == (None, 0.0)
+    assert windowed_rate("c", 60.0, now=NOW, store=st) is None
+
+
+def test_windowed_increase_sums_label_sets():
+    st = _store(
+        ("c", {"reason": "a"}, [(NOW - 20, 1.0)]),
+        ("c", {"reason": "b"}, [(NOW - 10, 2.0)]),
+    )
+    inc, _ = windowed_increase("c", 60.0, now=NOW, store=st)
+    assert inc == pytest.approx(3.0)
+    only_b, _ = windowed_increase(
+        "c", 60.0, now=NOW, labels={"reason": "b"}, store=st
+    )
+    assert only_b == pytest.approx(2.0)
+
+
+def test_latest_value_staleness_and_label_collections():
+    st = _store(
+        ("g", {"route": "train"}, [(NOW - 5, 3.0)]),
+        ("g", {"route": "gone"}, [(NOW - 500, 9.0)]),  # evicted cell
+        ("g", {"route": "dataset"}, [(NOW - 5, 7.0)]),
+    )
+    # stale series dropped; collection-valued label filter is an include-list
+    v = latest_value(
+        "g", {"route": ["train", "gone"]}, now=NOW, max_age_s=120.0, store=st
+    )
+    assert v == pytest.approx(3.0)
+    assert latest_value("g", {"route": "gone"}, now=NOW, max_age_s=120.0,
+                        store=st) is None
+    assert latest_value("g", now=NOW, max_age_s=None, store=st) == 9.0
+
+
+# ---------------- alert-rule state machine ----------------
+
+
+def _engine(rules, store):
+    eng = AlertEngine(rules, interval_s=0.0)
+    eng._store = store
+    return eng
+
+
+def test_threshold_rule_for_s_pending_then_fire_then_resolve():
+    st = _store(("g", {}, [(NOW - 1, 5.0)]))
+    rule = AlertRule(name="r", metric="g", kind="threshold",
+                     threshold=2.0, for_s=10.0, max_age_s=1e9)
+    eng = _engine([rule], st)
+    before = RECORDER.last_seq()
+    eng.evaluate(now=NOW, force=True)
+    assert eng.firing() == []  # pending, not firing
+    assert eng.snapshot()["alerts"][0]["state"] == "pending"
+    eng.evaluate(now=NOW + 11, force=True)
+    assert eng.firing() == ["r"]
+    # breach clears -> resolve
+    st._append("g", {}, NOW + 20, 1.0)
+    eng.evaluate(now=NOW + 21, force=True)
+    assert eng.firing() == []
+    events, _ = RECORDER.events(since=before)
+    kinds = [(e["kind"], e["data"].get("rule")) for e in events
+             if e["kind"].startswith("alert.")]
+    assert ("alert.fire", "r") in kinds and ("alert.resolve", "r") in kinds
+    fire = next(e for e in events if e["kind"] == "alert.fire"
+                and e["data"]["rule"] == "r")
+    assert fire["data"]["value"] == pytest.approx(5.0)
+    resolve = next(e for e in events if e["kind"] == "alert.resolve"
+                   and e["data"]["rule"] == "r")
+    assert resolve["data"]["firing_s"] == pytest.approx(10.0, abs=1.5)
+
+
+def test_pending_breach_that_clears_never_fires():
+    st = _store(("g", {}, [(NOW - 1, 5.0)]))
+    rule = AlertRule(name="r", metric="g", kind="threshold",
+                     threshold=2.0, for_s=10.0, max_age_s=1e9)
+    eng = _engine([rule], st)
+    eng.evaluate(now=NOW, force=True)
+    st._append("g", {}, NOW + 2, 0.5)
+    eng.evaluate(now=NOW + 3, force=True)  # cleared while pending
+    assert eng.snapshot()["alerts"][0]["state"] == "ok"
+    eng.evaluate(now=NOW + 30, force=True)
+    assert eng.firing() == []
+
+
+def test_burn_rate_requires_both_windows():
+    """A fresh burst breaches the short window but not yet the long one:
+    multi-window burn rate must NOT fire on the blip, then fires once the
+    long window burns too (SRE workbook semantics)."""
+    # counter flat at 0 for 80 s, then +10 in the last 20 s
+    samples = [(NOW - 100 + i * 10, 0.0) for i in range(9)]
+    samples += [(NOW - 10, 5.0), (NOW, 10.0)]
+    st = _store(("c", {}, samples))
+    rule = AlertRule(name="burn", metric="c", kind="burn_rate",
+                     threshold=0.2, windows_s=(30.0, 120.0))
+    eng = _engine([rule], st)
+    # short: 10/30 = 0.33 > 0.2; long: 10/100 = 0.1 < 0.2 -> no fire
+    eng.evaluate(now=NOW, force=True)
+    assert eng.firing() == []
+    # burn continues: +30 more over the next 60 s -> long window burns too
+    for i in range(1, 7):
+        st._append("c", {}, NOW + i * 10, 10.0 + i * 5.0)
+    eng.evaluate(now=NOW + 60, force=True)
+    assert eng.firing() == ["burn"]
+
+
+def test_increase_rule_fires_on_strict_overflow():
+    """Pinned: the default stage_cache_overflow rule must fire when the
+    strict valve refuses an upload (one counter bump), and resolve once
+    the window slides past — the doc row says 'Alert on this counter'."""
+    cfg = FrameworkConfig.load(env={})
+    rule = next(r for r in default_rules(cfg)
+                if r.name == "stage_cache_overflow")
+    assert rule.kind == "increase" and rule.severity == "page"
+    REGISTRY.counter("tpuml_stage_cache_overflow_total").inc(reason="strict")
+    timeseries_sample(force=True)
+    eng = AlertEngine([rule], interval_s=0.0)
+    before = RECORDER.last_seq()
+    eng.evaluate(force=True)
+    assert eng.firing() == ["stage_cache_overflow"]
+    events, _ = RECORDER.events(since=before)
+    assert any(e["kind"] == "alert.fire"
+               and e["data"]["rule"] == "stage_cache_overflow"
+               for e in events)
+    # firing gauge follows the state machine
+    cells = {
+        tuple(sorted(labels.items())): value
+        for labels, value in REGISTRY.get("tpuml_alert_firing").cells()
+    }
+    assert cells[(("rule", "stage_cache_overflow"),)] == 1.0
+    # window slides past the bump -> increase drops to 0 -> resolve
+    eng.evaluate(now=time.time() + float(rule.windows_s[0]) + 60, force=True)
+    assert eng.firing() == []
+    events, _ = RECORDER.events(since=before)
+    assert any(e["kind"] == "alert.resolve"
+               and e["data"]["rule"] == "stage_cache_overflow"
+               for e in events)
+
+
+def test_bad_rule_does_not_mute_the_rest():
+    st = _store(("g", {}, [(NOW, 5.0)]))
+    bad = AlertRule(name="bad", metric="g", kind="nope")
+    good = AlertRule(name="good", metric="g", kind="threshold",
+                     threshold=1.0, max_age_s=1e9)
+    eng = _engine([bad, good], st)
+    eng.evaluate(now=NOW + 1, force=True)
+    assert eng.firing() == ["good"]
+
+
+def test_default_ruleset_names_and_shapes():
+    cfg = FrameworkConfig.load(env={})
+    rules = {r.name: r for r in default_rules(cfg)}
+    assert set(rules) == {
+        "admission_reject_rate", "route_p99_slo", "sse_lag",
+        "worker_breaker_trips", "stage_cache_overflow",
+    }
+    assert rules["admission_reject_rate"].kind == "burn_rate"
+    assert len(rules["admission_reject_rate"].windows_s) == 2
+    assert rules["route_p99_slo"].threshold == cfg.service.route_p99_slo_s
+    # blocking routes must NOT be SLO-covered
+    covered = rules["route_p99_slo"].labels["route"]
+    for blocking in ("next_tasks", "train_status", "dataset"):
+        assert blocking not in covered
+    assert "train" in covered and "health" in covered
+
+
+# ---------------- capacity signals ----------------
+
+
+def _stub_coord(cfg, *, jobs=0, pending=0, workers=None, n_shards=1,
+                shard_id=None):
+    workers = workers or {}
+    engine = SimpleNamespace(
+        worker_snapshot=lambda: workers,
+        total_devices=lambda: sum(
+            int(w.get("n_devices") or 1) for w in workers.values()
+        ),
+    )
+    return SimpleNamespace(
+        config=cfg,
+        store=SimpleNamespace(unfinished_counts=lambda: {
+            "jobs": jobs, "per_session": {}, "pending_subtasks": pending,
+        }),
+        cluster=SimpleNamespace(engine=engine),
+        n_shards=n_shards,
+        shard_id=shard_id,
+    )
+
+
+def _svc_cfg(**kw):
+    cfg = FrameworkConfig.load(env={})
+    for k, v in kw.items():
+        setattr(cfg.service, k, v)
+    return cfg
+
+
+def test_signals_backlog_demand_sizing():
+    # 120 s of predictor-priced backlog over a 10 s horizon -> 12 workers
+    cfg = _svc_cfg(autoscale_horizon_s=10.0, autoscale_min_workers=1)
+    workers = {
+        f"w{i}": {"queue_depth": 4, "load_seconds": 40.0, "n_devices": 2}
+        for i in range(3)
+    }
+    sig = CapacitySignals(_stub_coord(cfg, pending=12, workers=workers))
+    rep = sig.evaluate(now=NOW, force=True)
+    assert rep["desired_workers"] == 12
+    assert rep["live_workers"] == 3
+    s = rep["signals"]
+    assert s["backlog_seconds"] == pytest.approx(120.0)
+    assert s["backlog_device_seconds"] == pytest.approx(240.0)
+    assert s["queued_subtasks"] == 12 and s["unplaced_subtasks"] == 0
+    assert s["pressure"] is False
+    assert rep["hysteresis"]["scale_down_held"] is False
+
+
+def test_signals_unplaced_subtasks_priced_at_mean_estimate():
+    # 2 queued tasks worth 20 s -> mean 10 s; 3 unplaced add 30 s
+    cfg = _svc_cfg(autoscale_horizon_s=5.0)
+    workers = {"w0": {"queue_depth": 2, "load_seconds": 20.0, "n_devices": 1}}
+    sig = CapacitySignals(_stub_coord(cfg, pending=5, workers=workers))
+    rep = sig.evaluate(now=NOW, force=True)
+    assert rep["signals"]["unplaced_subtasks"] == 3
+    assert rep["signals"]["backlog_seconds"] == pytest.approx(50.0)
+    assert rep["desired_workers"] == math.ceil(50.0 / 5.0)
+
+
+def test_signals_pressure_bumps_past_live():
+    # admission cap saturated: desired must exceed live even with no backlog
+    cfg = _svc_cfg(max_inflight_jobs=4)
+    workers = {
+        f"w{i}": {"queue_depth": 0, "load_seconds": 0.0, "n_devices": 1}
+        for i in range(4)
+    }
+    sig = CapacitySignals(_stub_coord(cfg, jobs=4, workers=workers))
+    rep = sig.evaluate(now=NOW, force=True)
+    assert rep["signals"]["pressure"] is True
+    assert rep["signals"]["admission_utilization"] >= 1.0
+    assert rep["desired_workers"] == 4 + 2  # live + ceil(live * 0.5)
+
+
+def test_signals_scale_down_hysteresis_and_drain_gate():
+    cfg = _svc_cfg(autoscale_downscale_hold_s=60.0)
+    idle = {
+        f"w{i}": {"queue_depth": 0, "load_seconds": 0.0, "n_devices": 1}
+        for i in range(4)
+    }
+    sig = CapacitySignals(_stub_coord(cfg, workers=idle))
+    # raw signal (min_workers=1) is below live=4: held at live first
+    rep = sig.evaluate(now=NOW, force=True)
+    assert rep["desired_workers"] == 4
+    assert rep["hysteresis"]["scale_down_held"] is True
+    assert rep["hysteresis"]["raw_desired_workers"] == 1
+    # still inside the hold window
+    rep = sig.evaluate(now=NOW + 30, force=True)
+    assert rep["desired_workers"] == 4
+    # hold elapsed AND all 4 drainable -> published signal drops
+    rep = sig.evaluate(now=NOW + 61, force=True)
+    assert rep["desired_workers"] == 1
+    assert rep["hysteresis"]["scale_down_held"] is False
+
+    # drain gate: loaded workers are never drainable, so the signal stays
+    # pinned at live no matter how long the raw signal holds below
+    busy = {
+        f"w{i}": {"queue_depth": 1, "load_seconds": 0.01, "n_devices": 1}
+        for i in range(4)
+    }
+    cfg2 = _svc_cfg(autoscale_downscale_hold_s=60.0,
+                    autoscale_horizon_s=1000.0)
+    sig2 = CapacitySignals(_stub_coord(cfg2, workers=busy))
+    sig2.evaluate(now=NOW, force=True)
+    rep = sig2.evaluate(now=NOW + 3600, force=True)
+    assert rep["desired_workers"] == 4
+    assert rep["hysteresis"]["scale_down_held"] is True
+    assert rep["hysteresis"]["drainable_workers"] == 0
+
+
+def test_signals_scale_up_resets_hold_clock():
+    cfg = _svc_cfg(autoscale_downscale_hold_s=60.0)
+    idle = {
+        f"w{i}": {"queue_depth": 0, "load_seconds": 0.0, "n_devices": 1}
+        for i in range(2)
+    }
+    coord = _stub_coord(cfg, workers=idle)
+    sig = CapacitySignals(coord)
+    sig.evaluate(now=NOW, force=True)  # below-live clock starts
+    # a burst of work pushes raw back above live -> clock must reset
+    coord.store.unfinished_counts = lambda: {
+        "jobs": 1, "per_session": {}, "pending_subtasks": 100000,
+    }
+    rep = sig.evaluate(now=NOW + 30, force=True)
+    assert rep["desired_workers"] > 2
+    coord.store.unfinished_counts = lambda: {
+        "jobs": 0, "per_session": {}, "pending_subtasks": 0,
+    }
+    # 61 s after the FIRST below-live mark, but the clock restarted: held
+    rep = sig.evaluate(now=NOW + 61, force=True)
+    assert rep["desired_workers"] == 2
+    assert rep["hysteresis"]["scale_down_held"] is True
+
+
+def test_signals_desired_shards_targets_fill():
+    cfg = _svc_cfg(max_inflight_jobs=10, autoscale_target_fill=0.5)
+    sig = CapacitySignals(_stub_coord(cfg, jobs=10, n_shards=2, shard_id=0))
+    rep = sig.evaluate(now=NOW, force=True)
+    # at 100% job fill with a 50% target: 2 shards -> 4
+    assert rep["desired_shards"] == 4
+    assert rep["n_shards"] == 2
+    assert rep["shard"] == 0
+
+
+def test_signals_gauges_published():
+    cfg = _svc_cfg(autoscale_horizon_s=10.0)
+    workers = {"w0": {"queue_depth": 1, "load_seconds": 30.0, "n_devices": 1}}
+    sig = CapacitySignals(_stub_coord(cfg, pending=1, workers=workers))
+    rep = sig.evaluate(now=NOW, force=True)
+    cells = dict()
+    for labels, value in REGISTRY.get("tpuml_autoscale_desired_workers").cells():
+        cells[tuple(sorted(labels.items()))] = value
+    assert cells[()] == float(rep["desired_workers"])
+    backlog = dict(
+        (tuple(sorted(l.items())), v)
+        for l, v in REGISTRY.get("tpuml_autoscale_backlog_seconds").cells()
+    )
+    assert backlog[()] == pytest.approx(30.0)
+
+
+# ---------------- direct-mode endpoints ----------------
+
+
+@pytest.fixture()
+def client():
+    from werkzeug.test import Client
+
+    return Client(create_app(Coordinator()))
+
+
+def test_alerts_endpoint_shape(client):
+    body = client.get("/alerts").get_json()
+    assert body["status"] in ("ok", "firing")
+    assert body["n_rules"] == 5
+    rules = {a["rule"]: a for a in body["alerts"]}
+    assert set(rules) == {
+        "admission_reject_rate", "route_p99_slo", "sse_lag",
+        "worker_breaker_trips", "stage_cache_overflow",
+    }
+    for a in body["alerts"]:
+        assert a["state"] in ("ok", "pending", "firing")
+        assert {"threshold", "cmp", "metric", "kind", "windows_s",
+                "severity", "description"} <= set(a)
+    # force re-evaluation bypasses the interval throttle
+    assert client.get("/alerts?force=1").status_code == 200
+
+
+def test_autoscale_endpoint_shape(client):
+    body = client.get("/autoscale").get_json()
+    assert body["desired_workers"] >= 1
+    assert body["live_workers"] == 0  # direct mode has no placement engine
+    assert body["desired_shards"] == 1 and body["n_shards"] == 1
+    assert {"backlog_seconds", "pending_subtasks", "admission_utilization",
+            "route_p99_s", "pressure", "idle_workers"} <= set(body["signals"])
+    assert {"raw_desired_workers", "scale_down_held",
+            "hold_s"} <= set(body["hysteresis"])
+
+
+def test_prom_scrape_exposes_health_gauges(client):
+    text = client.get("/metrics/prom").get_data(as_text=True)
+    assert "tpuml_autoscale_desired_workers" in text
+    assert "tpuml_autoscale_desired_shards" in text

@@ -276,6 +276,43 @@ def test_metric_catalog_documented():
     )
 
 
+def test_event_kind_catalog_documented():
+    """Every flight-recorder event kind the package can emit must appear
+    (backticked) in docs/OBSERVABILITY.md — same drift gate as the metric
+    catalog, for the event catalog.  Scans ``record_event("<kind>", ...)``
+    call sites (including the ``"a" if cond else "b"`` ternary form used by
+    the stage cache) across the package, excluding tests."""
+    root = os.path.join(
+        os.path.dirname(__file__), "..", "cs230_distributed_machine_learning_tpu"
+    )
+    kind_pat = re.compile(
+        r"record_event\(\s*\n?\s*\"([a-z][a-z0-9_.]*)\""
+        r"(?:\s+if\s+[^,)]*?\selse\s+\"([a-z][a-z0-9_.]*)\")?"
+    )
+    emitted = set()
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            src = open(os.path.join(dirpath, fn)).read()
+            for m in kind_pat.finditer(src):
+                emitted.add(m.group(1))
+                if m.group(2):
+                    emitted.add(m.group(2))
+    # the scan must actually see the recorder's bread-and-butter kinds —
+    # if the call-site idiom changes, fail loudly instead of passing empty
+    assert {"placement", "result", "alert.fire", "alert.resolve"} <= emitted
+    doc_path = os.path.join(
+        os.path.dirname(__file__), "..", "docs", "OBSERVABILITY.md"
+    )
+    documented = set(re.findall(r"`([a-z][a-z0-9_.]*)`", open(doc_path).read()))
+    missing = sorted(emitted - documented)
+    assert not missing, (
+        f"event kinds emitted but undocumented in docs/OBSERVABILITY.md: "
+        f"{missing}"
+    )
+
+
 # ---------------- REST endpoints (direct-mode coordinator) ----------------
 
 
@@ -293,12 +330,15 @@ def test_dashboard_renders_with_all_panels(client):
     html = resp.get_data(as_text=True)
     for panel in ("Jobs", "Latest job trace", "Latest job cost",
                   "Metrics history", "Flight recorder", "Workers",
-                  "Queues", "Supervised agents"):
+                  "Queues", "Supervised agents", "Fleet health"):
         assert panel in html, f"dashboard panel {panel!r} missing"
+    for elem_id in ('id="autoscale"', 'id="alerts"'):
+        assert elem_id in html, f"dashboard element {elem_id} missing"
     # every JSON feed the dashboard polls must answer on a fresh,
     # empty-state coordinator (no 500s)
     for path in ("/jobs", "/workers", "/queues", "/supervisor", "/events",
-                 "/metrics/history", "/predictor/calibration"):
+                 "/metrics/history", "/predictor/calibration",
+                 "/alerts", "/autoscale"):
         assert client.get(path).status_code == 200, path
 
 

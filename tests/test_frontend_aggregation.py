@@ -1,0 +1,310 @@
+"""Front-end fleet aggregation (runtime/frontend.py): merged Prometheus
+exposition (metadata dedup, histogram buckets, shard-label injection on
+hostile label values), /events per-shard cursor paging (no duplicate or
+skipped (shard, seq) across page boundaries), /alerts union, /autoscale
+fleet sums, and /metrics/history shard labeling — all against FAKE shard
+servers serving canned bodies, so every merge path is pinned without a
+full coordinator fleet."""
+
+import json
+import threading
+
+import pytest
+from werkzeug.serving import make_server
+from werkzeug.test import Client
+from werkzeug.wrappers import Request, Response
+
+from cs230_distributed_machine_learning_tpu.runtime.frontend import (
+    _inject_shard_label,
+    create_frontend_app,
+)
+
+
+# ---------------- _inject_shard_label (pure) ----------------
+
+
+def test_inject_shard_label_plain_and_labeled():
+    body = "\n".join([
+        "# HELP tpuml_x things",
+        "# TYPE tpuml_x counter",
+        "tpuml_x 3",
+        'tpuml_y{route="train"} 1.5',
+        "",
+    ])
+    out = _inject_shard_label(body, 2)
+    assert 'tpuml_x{shard="2"} 3' in out
+    assert 'tpuml_y{shard="2",route="train"} 1.5' in out
+    assert "# HELP tpuml_x things" in out  # comments pass through untouched
+
+
+def test_inject_shard_label_hostile_label_values():
+    # label VALUES may contain spaces, escaped quotes, braces, and the
+    # sample may carry a timestamp — the rewrite must only touch the
+    # series name, reassembling everything after it byte-identically
+    hostile = 'tpuml_e{msg="q\\" {b} c",x="y"} 7 1699999999'
+    (out,) = _inject_shard_label(hostile, 0)
+    assert out == 'tpuml_e{shard="0",msg="q\\" {b} c",x="y"} 7 1699999999'
+    bucket = 'tpuml_lat_bucket{route="train",le="0.5"} 3'
+    (out,) = _inject_shard_label(bucket, 1)
+    assert out == 'tpuml_lat_bucket{shard="1",route="train",le="0.5"} 3'
+
+
+# ---------------- fake shard fleet ----------------
+
+
+def _fake_shard(handlers):
+    """Serve ``handlers`` = {path: callable(request) -> dict | Response}
+    on an ephemeral port; unknown paths 404."""
+
+    @Request.application
+    def app(request):
+        h = handlers.get(request.path)
+        if h is None:
+            return Response(
+                json.dumps({"status": "error", "message": "not found"}),
+                status=404, mimetype="application/json",
+            )
+        out = h(request)
+        if isinstance(out, Response):
+            return out
+        return Response(json.dumps(out), mimetype="application/json")
+
+    srv = make_server("127.0.0.1", 0, app, threaded=True)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_port}"
+
+
+def _events_handler(events):
+    """A shard's /events contract: seq-ascending, honors since/limit."""
+
+    def h(request):
+        since = int(request.args.get("since", 0))
+        limit = int(request.args.get("limit", 1000))
+        evs = [dict(e) for e in events if e["seq"] > since][:limit]
+        return {
+            "events": evs,
+            "n_events": len(evs),
+            "last_seq": evs[-1]["seq"] if evs else since,
+        }
+
+    return h
+
+
+_PROM_0 = "\n".join([
+    "# HELP tpuml_jobs_submitted_total jobs",
+    "# TYPE tpuml_jobs_submitted_total counter",
+    "tpuml_jobs_submitted_total 5",
+    "# HELP tpuml_http_request_seconds latency",
+    "# TYPE tpuml_http_request_seconds histogram",
+    'tpuml_http_request_seconds_bucket{route="train",le="0.5"} 3',
+    'tpuml_http_request_seconds_bucket{route="train",le="+Inf"} 4',
+    'tpuml_http_request_seconds_count{route="train"} 4',
+    "",
+])
+_PROM_1 = "\n".join([
+    "# HELP tpuml_jobs_submitted_total jobs",
+    "# TYPE tpuml_jobs_submitted_total counter",
+    "tpuml_jobs_submitted_total 7",
+    'tpuml_weird{msg="a\\" b"} 1',
+    "",
+])
+
+
+@pytest.fixture()
+def fleet():
+    """Two fake shards + a frontend WSGI client over real HTTP fan-out."""
+    ev0 = [{"seq": i, "kind": f"k0.{i}", "ts": 100.0 + i, "data": {}}
+           for i in range(1, 8)]
+    ev1 = [{"seq": i, "kind": f"k1.{i}", "ts": 200.0 + i, "data": {}}
+           for i in range(1, 6)]
+    shard0 = {
+        "/events": _events_handler(ev0),
+        "/metrics/prom": lambda r: Response(_PROM_0, mimetype="text/plain"),
+        "/alerts": lambda r: {
+            "status": "firing", "firing": ["admission_reject_rate"],
+            "alerts": [
+                {"rule": "admission_reject_rate", "state": "firing",
+                 "value": 0.5, "severity": "page"},
+                {"rule": "sse_lag", "state": "ok", "value": 0.0,
+                 "severity": "warn"},
+            ],
+        },
+        "/autoscale": lambda r: {
+            "desired_workers": 3, "live_workers": 2, "desired_shards": 2,
+            "signals": {"pressure": True}, "shard": 0,
+        },
+        "/metrics/history": lambda r: (
+            {"names": ["tpuml_a", "tpuml_b"]} if not r.args.get("name")
+            else {"name": r.args["name"], "series": [
+                {"labels": {"route": "train"}, "samples": [[1.0, 2.0]]},
+            ]}
+        ),
+    }
+    shard1 = {
+        "/events": _events_handler(ev1),
+        "/metrics/prom": lambda r: Response(_PROM_1, mimetype="text/plain"),
+        "/alerts": lambda r: {
+            "status": "ok", "firing": [],
+            "alerts": [
+                {"rule": "admission_reject_rate", "state": "ok",
+                 "value": 0.0, "severity": "page"},
+                {"rule": "sse_lag", "state": "ok", "value": 0.0,
+                 "severity": "warn"},
+            ],
+        },
+        "/autoscale": lambda r: {
+            "desired_workers": 1, "live_workers": 1, "desired_shards": 3,
+            "signals": {"pressure": False}, "shard": 1,
+        },
+        "/metrics/history": lambda r: (
+            {"names": ["tpuml_b", "tpuml_c"]} if not r.args.get("name")
+            else {"name": r.args["name"], "series": [
+                {"labels": {"route": "train"}, "samples": [[1.5, 4.0]]},
+            ]}
+        ),
+    }
+    srv0, url0 = _fake_shard(shard0)
+    srv1, url1 = _fake_shard(shard1)
+    client = Client(create_frontend_app([url0, url1]))
+    yield {"client": client, "servers": (srv0, srv1),
+           "n_events": len(ev0) + len(ev1)}
+    for srv in (srv0, srv1):
+        srv.shutdown()
+
+
+# ---------------- merged /metrics/prom ----------------
+
+
+def test_frontend_prom_merge_dedups_metadata_and_labels_series(fleet):
+    resp = fleet["client"].get("/metrics/prom")
+    assert resp.status_code == 200
+    assert "version=0.0.4" in resp.headers["Content-Type"]
+    text = resp.get_data(as_text=True)
+    lines = text.splitlines()
+    # HELP/TYPE present exactly once even though both shards sent them
+    assert lines.count("# HELP tpuml_jobs_submitted_total jobs") == 1
+    assert lines.count("# TYPE tpuml_jobs_submitted_total counter") == 1
+    # the same family from both shards stays distinct via the shard label
+    assert 'tpuml_jobs_submitted_total{shard="0"} 5' in lines
+    assert 'tpuml_jobs_submitted_total{shard="1"} 7' in lines
+    # histogram bucket series keep their le= label after injection
+    assert ('tpuml_http_request_seconds_bucket'
+            '{shard="0",route="train",le="0.5"} 3') in lines
+    assert ('tpuml_http_request_seconds_bucket'
+            '{shard="0",route="train",le="+Inf"} 4') in lines
+    # hostile escaped-quote label value survives the rewrite
+    assert 'tpuml_weird{shard="1",msg="a\\" b"} 1' in lines
+
+
+# ---------------- /events cursor paging ----------------
+
+
+def test_frontend_events_plain_int_since_applies_fleet_wide(fleet):
+    body = fleet["client"].get("/events?since=5").get_json()
+    # seq > 5 on every shard: shard0 has 6,7 — shard1 (max seq 5) nothing
+    assert [(e["shard"], e["seq"]) for e in body["events"]] == [
+        (0, 6), (0, 7),
+    ]
+    assert body["cursors"] == {"0": 7, "1": 5}
+
+
+def test_frontend_events_cursor_paging_no_dups_no_skips(fleet):
+    client = fleet["client"]
+    seen = []
+    cursor = ""
+    for _ in range(16):
+        qs = {"limit": 4}
+        if cursor:
+            qs["since"] = cursor
+        body = client.get("/events", query_string=qs).get_json()
+        if not body["events"]:
+            break
+        assert len(body["events"]) <= 4
+        # merged page is (seq, shard)-ordered
+        keys = [(e["seq"], e["shard"]) for e in body["events"]]
+        assert keys == sorted(keys)
+        seen.extend((e["shard"], e["seq"]) for e in body["events"])
+        cursor = body["cursor"]  # opaque JSON cursor map, passed back
+    # every (shard, seq) exactly once across page boundaries
+    assert len(seen) == len(set(seen)) == fleet["n_events"]
+    assert set(seen) == (
+        {(0, i) for i in range(1, 8)} | {(1, i) for i in range(1, 6)}
+    )
+    # drained: the final cursor yields an empty page, same cursor back
+    body = client.get(
+        "/events", query_string={"since": cursor, "limit": 4}
+    ).get_json()
+    assert body["events"] == [] and body["cursor"] == cursor
+
+
+def test_frontend_events_stamps_shard_attribution(fleet):
+    body = fleet["client"].get("/events").get_json()
+    kinds = {(e["shard"], e["kind"]) for e in body["events"]}
+    assert (0, "k0.1") in kinds and (1, "k1.1") in kinds
+    # legacy single-int field is dead; the map is authoritative
+    assert body["last_seq"] == 0
+    assert json.loads(body["cursor"]) == body["cursors"]
+
+
+# ---------------- /alerts union ----------------
+
+
+def test_frontend_alerts_union_with_shard_attribution(fleet):
+    body = fleet["client"].get("/alerts").get_json()
+    assert body["status"] == "firing"
+    assert body["n_firing"] == 1
+    assert body["firing"] == [{"rule": "admission_reject_rate", "shard": 0}]
+    # the SAME rule appears once per shard — firing on 0, ok on 1
+    states = {
+        (a["rule"], a["shard"]): a["state"] for a in body["alerts"]
+    }
+    assert states[("admission_reject_rate", 0)] == "firing"
+    assert states[("admission_reject_rate", 1)] == "ok"
+    assert len(body["alerts"]) == 4
+    assert body["shards_down"] == []
+
+
+# ---------------- /autoscale fleet sums ----------------
+
+
+def test_frontend_autoscale_sums_and_attribution(fleet):
+    body = fleet["client"].get("/autoscale").get_json()
+    assert body["desired_workers"] == 4  # 3 + 1
+    assert body["live_workers"] == 3  # 2 + 1
+    assert body["desired_shards"] == 3  # max(2, 3): most pressured view
+    assert body["n_shards"] == 2
+    # per-shard bodies ride along for attribution
+    assert body["shards"]["0"]["signals"]["pressure"] is True
+    assert body["shards"]["1"]["signals"]["pressure"] is False
+
+
+# ---------------- /metrics/history ----------------
+
+
+def test_frontend_metrics_history_names_union_and_shard_labels(fleet):
+    client = fleet["client"]
+    names = client.get("/metrics/history").get_json()["names"]
+    assert names == ["tpuml_a", "tpuml_b", "tpuml_c"]
+    body = client.get(
+        "/metrics/history", query_string={"name": "tpuml_b"}
+    ).get_json()
+    assert body["name"] == "tpuml_b"
+    shards = sorted(s["labels"]["shard"] for s in body["series"])
+    assert shards == ["0", "1"]
+    for s in body["series"]:
+        assert s["labels"]["route"] == "train"  # original labels kept
+
+
+# ---------------- degraded fleet ----------------
+
+
+def test_frontend_health_plane_reports_downed_shard(fleet):
+    fleet["servers"][1].shutdown()
+    client = fleet["client"]
+    alerts = client.get("/alerts").get_json()
+    assert alerts["shards_down"] == [1]
+    # shard 0's alerts still answer
+    assert any(a["shard"] == 0 for a in alerts["alerts"])
+    scale = client.get("/autoscale").get_json()
+    assert scale["shards_down"] == [1]
+    assert scale["desired_workers"] == 3  # the live shard's view only
