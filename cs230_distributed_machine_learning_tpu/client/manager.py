@@ -478,6 +478,46 @@ class MLTaskManager:
                 ) from e
             raise
 
+    def critical_path(
+        self, job_id: Optional[str] = None, compare: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Exact wall-clock decomposition of one job: the critical-path
+        report (docs/OBSERVABILITY.md "Critical path & trace export") —
+        segments that tile submit→aggregate (gaps labeled ``untraced``),
+        the dominant segment, and retry/speculation attribution. Pass
+        ``compare=<baseline_job_id>`` to attach a per-segment diff
+        against another job (``report["diff"]``). ``job_id`` defaults to
+        the latest ``train()``; raises KeyError when the coordinator has
+        no trace bound for the job (unknown id or ``CS230_OBS=0``)."""
+        jid = job_id or self.job_id
+        if jid is None:
+            raise TypeError(
+                "critical_path() requires a job id (or a prior train())"
+            )
+        if self._coordinator is not None:
+            report = self._coordinator.critical_path(jid)
+            if report is None:
+                raise KeyError(f"no critical path for job {jid!r}")
+            if compare is not None:
+                from ..obs.critpath import compare as _compare
+
+                base = self._coordinator.critical_path(compare)
+                if base is None:
+                    raise KeyError(f"no critical path for job {compare!r}")
+                report["diff"] = _compare(base, report)
+            return report
+        import requests
+
+        try:
+            return self._request(
+                "get", f"critical_path/{jid}",
+                params={"compare": compare} if compare is not None else None,
+            )
+        except requests.HTTPError as e:
+            if e.response is not None and e.response.status_code == 404:
+                raise KeyError(f"no critical path for job {jid!r}") from e
+            raise
+
     def best_result(self, job_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
         status = self.check_status(job_id)
         result = status.get("job_result") or {}

@@ -37,11 +37,20 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from .critpath import (  # noqa: F401 — re-exported API
+    compare as compare_critical_paths,
+    critical_path,
+)
 from .devprof import (  # noqa: F401 — re-exported API
     PROFILER,
     DeviceProfiler,
     device_seconds,
     record_batch_device_seconds,
+)
+from .export import (  # noqa: F401 — re-exported API
+    export_trace,
+    to_otlp,
+    to_perfetto,
 )
 from .metrics import (  # noqa: F401 — re-exported API
     CALIBRATION_BUCKETS,
@@ -72,6 +81,7 @@ from .timeseries import (  # noqa: F401 — re-exported API
 )
 from .tracing import _enabled as _valve
 from .tracing import (  # noqa: F401 — re-exported API
+    PARENT_HEADER,
     TRACE_HEADER,
     TRACER,
     Tracer,
@@ -565,9 +575,15 @@ __all__ = [
     "AlertEngine",
     "AlertRule",
     "default_rules",
+    "critical_path",
+    "compare_critical_paths",
+    "export_trace",
+    "to_perfetto",
+    "to_otlp",
     "TRACER",
     "Tracer",
     "TRACE_HEADER",
+    "PARENT_HEADER",
     "span",
     "record_phase",
     "activate",

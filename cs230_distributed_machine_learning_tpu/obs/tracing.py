@@ -56,6 +56,11 @@ _MAX_SPANS_PER_TRACE = 2048
 _MAX_JOBS = 1024
 
 TRACE_HEADER = "X-Trace-Id"
+#: parent-span propagation for multi-hop stitching: a front end sends the
+#: span id of its open ``frontend.proxy`` span so the shard's
+#: ``http.<endpoint>`` span nests under it instead of surfacing as a
+#: second root (docs/OBSERVABILITY.md "Critical path & trace export")
+PARENT_HEADER = "X-Parent-Span"
 
 
 def new_trace_id() -> str:
@@ -164,21 +169,51 @@ class Tracer:
         tid = span.get("trace_id")
         if not tid:
             return
+        dropped: Dict[str, int] = {}
         with self._lock:
             spans = self._traces.get(tid)
             if spans is None:
                 spans = []
                 self._traces[tid] = spans
                 while len(self._traces) > _MAX_TRACES:
-                    self._traces.popitem(last=False)
+                    # whole-trace eviction, oldest first (insertion /
+                    # last-touch order); every span of the victim is a drop
+                    _vid, victim = self._traces.popitem(last=False)
+                    dropped["trace_evicted"] = (
+                        dropped.get("trace_evicted", 0) + len(victim)
+                    )
             else:
                 self._traces.move_to_end(tid)
             if len(spans) < _MAX_SPANS_PER_TRACE:
                 spans.append(span)
+            else:
+                # runaway-instrumentation guard hit: the span never lands
+                # in the ring (the journal line below still writes)
+                dropped["trace_full"] = dropped.get("trace_full", 0) + 1
             if self._pending is not None:
                 self._pending.append(span)
+        if dropped:
+            self._count_dropped(dropped)
         if self._journal:
             self._journal_write(span)
+
+    @staticmethod
+    def _count_dropped(dropped: Dict[str, int]) -> None:
+        """Surface ring overflow (``tpuml_trace_spans_dropped_total``,
+        labeled by reason) — a silent drop reads as 'the job recorded
+        nothing there', which is exactly the lie the critical-path
+        engine's ``untraced`` contract exists to avoid. Lazy import:
+        metrics imports nothing from here, but the facade imports both,
+        so the top level must stay acyclic."""
+        try:
+            from .metrics import REGISTRY
+
+            for reason, n in dropped.items():
+                REGISTRY.counter("tpuml_trace_spans_dropped_total").inc(
+                    n, reason=reason
+                )
+        except Exception:  # noqa: BLE001 — accounting must not fail recording
+            pass
 
     def ingest(self, spans: List[Dict[str, Any]]) -> int:
         """Accept remotely-recorded spans (the /trace_spans route). Returns

@@ -1404,6 +1404,50 @@ class Coordinator:
             "groups": groups,
         }
 
+    def critical_path(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Exact wall-clock decomposition of one job (obs/critpath.py):
+        the span tree joined with the flight-recorder timelines, tiled
+        into labeled critical-path segments that sum to the measured
+        wall (gaps labeled ``untraced``). None when no trace is bound to
+        the job — the ``GET /critical_path`` 404. Schema:
+        docs/OBSERVABILITY.md "Critical path & trace export"."""
+        from ..obs.critpath import critical_path as _critical_path
+
+        tid = TRACER.trace_for_job(job_id)
+        if tid is None:
+            return None
+        timelines = {
+            stid: RECORDER.timeline(job_id, stid) or []
+            for stid in RECORDER.job_subtasks(job_id)
+        }
+        # the store-measured wall (created_at -> completion_time), when
+        # the job record still exists, cross-checks the span window
+        job_wall = None
+        sid = next(
+            (
+                j["session_id"]
+                for j in self.store.jobs_overview()
+                if j["job_id"] == job_id
+            ),
+            None,
+        )
+        if sid is not None:
+            try:
+                job = self.store.get_job(sid, job_id)
+                if job.get("completion_time") and job.get("created_at"):
+                    job_wall = float(job["completion_time"]) - float(
+                        job["created_at"]
+                    )
+            except KeyError:
+                pass
+        return _critical_path(
+            job_id,
+            trace_id=tid,
+            spans=TRACER.spans_for(tid),
+            timelines=timelines,
+            job_wall_s=job_wall,
+        )
+
     def explain(self, job_id: str, subtask_id: str) -> Dict[str, Any]:
         """Flight-recorder timeline for one subtask — every lifecycle
         decision in order (placement with score breakdown, lease grant/

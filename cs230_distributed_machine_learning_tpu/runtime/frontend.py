@@ -41,10 +41,20 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs.tracing import (
+    PARENT_HEADER,
+    TRACE_HEADER,
+    TRACER,
+    _enabled as _obs_enabled,
+    new_span_id,
+    new_trace_id,
+)
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
 from .sharding import id_shard, shard_of
@@ -61,8 +71,9 @@ _WORKER_ROUTES = {
     "unsubscribe", "heartbeat", "next_tasks", "task_result", "task_metrics",
     "trace_spans",
 }
-#: routed by the job-id stamp (scatter probe for unstamped ids)
-_JOB_ROUTES = {"trace", "cost", "explain"}
+#: routed by the job-id stamp (scatter probe for unstamped ids); "trace"
+#: also covers /trace/<jid>/export — the stamp is still parts[1]
+_JOB_ROUTES = {"trace", "cost", "explain", "critical_path"}
 #: response headers forwarded from the shard to the client
 _FWD_HEADERS = (
     "Content-Type", "Retry-After", "X-Trace-Id", "X-Dataset-Kind",
@@ -168,6 +179,15 @@ def create_frontend_app(shard_urls: List[str]):
             v = request.headers.get(h)
             if v:
                 headers[h] = v
+        # the fleet's first hop is traced (frontend.proxy, see app()):
+        # forward the — possibly front-end-minted — trace id plus the
+        # proxy span's id, so the shard's http.<endpoint> span nests
+        # under it instead of surfacing as a second trace root
+        ctx = getattr(request, "tpuml_trace", None)
+        if ctx is not None:
+            headers[TRACE_HEADER] = ctx[0]
+            headers[PARENT_HEADER] = ctx[1]
+        request.tpuml_shard = k
         if body is not None:
             data = body
         else:
@@ -664,10 +684,40 @@ def create_frontend_app(shard_urls: List[str]):
             {"status": "error", "message": "not found"}, status=404
         )
 
+    def _ship_span(k: int, span: Dict[str, Any]) -> None:
+        """Stitch the proxy span into the owning shard's tracer
+        (POST /trace_spans, the same return leg remote agents use) —
+        best-effort: a lost span degrades the fleet view, never the
+        request."""
+        try:
+            session.post(
+                f"{urls[k]}/trace_spans/frontend",
+                json={"spans": [json_safe(span)]},
+                timeout=5,
+            )
+        except requests.RequestException:
+            logger.debug("frontend.proxy span shipping to shard %d failed", k)
+
     @Request.application
     def app(request):
         if request.method == "OPTIONS":
             return Response(status=204, headers=_cors)
+        # frontend.proxy span — the fleet's first hop, previously the
+        # trace blind spot: the trace id is MINTED here when the client
+        # sent none, so every relayed request is traced from first
+        # contact. /trace_spans relays are exempt (they are the span
+        # TRANSPORT — a meta-span per shipped batch would pollute every
+        # job trace it carries).
+        head = request.path.split("/")[1] if "/" in request.path else ""
+        inbound_tid = request.headers.get(TRACE_HEADER)
+        traced = _obs_enabled() and head != "trace_spans"
+        trace_id = inbound_tid
+        span_id = None
+        t0 = time.time()
+        if traced:
+            trace_id = inbound_tid or new_trace_id()
+            span_id = new_span_id()
+            request.tpuml_trace = (trace_id, span_id)
         try:
             resp = _route(request)
         except Exception as e:  # noqa: BLE001 — a routing bug must answer
@@ -676,6 +726,36 @@ def create_frontend_app(shard_urls: List[str]):
                 {"status": "error", "message": str(e)}, status=500
             )
         resp.headers.extend(_cors)
+        if trace_id:
+            resp.headers[TRACE_HEADER] = trace_id
+        shard = getattr(request, "tpuml_shard", None)
+        # record only client-traced or single-shard-relayed requests:
+        # local aggregates polled untraced (/jobs, /events, the
+        # dashboard's 2 s tick) must not churn the trace ring with
+        # one-span garbage traces
+        if traced and (inbound_tid or shard is not None):
+            proxy_span = {
+                "trace_id": trace_id,
+                "span_id": span_id,
+                "parent_id": None,
+                "name": "frontend.proxy",
+                "start": t0,
+                "end": time.time(),
+                "attrs": {
+                    "route": head or "/",
+                    "path": request.path,
+                    "method": request.method,
+                    "status": resp.status_code,
+                    "shard": shard,
+                    "minted": inbound_tid is None,
+                },
+                "process": f"frontend:{os.getpid()}",
+            }
+            # local ring + the front end's own spans.jsonl journal ...
+            TRACER.record(proxy_span)
+            # ... and stitched into the owning shard's fleet view
+            if shard is not None:
+                fan_pool.submit(_ship_span, shard, proxy_span)
         return resp
 
     app.shard_urls = urls

@@ -18,13 +18,16 @@ import json
 from typing import Optional
 
 from ..obs import (
+    PARENT_HEADER,
     PROFILER,
     RECORDER,
     TIMESERIES,
     TRACE_HEADER,
     TRACER,
     activate,
+    compare_critical_paths,
     counter_inc,
+    export_trace,
     gauge_set,
     obs_enabled,
     observe,
@@ -53,6 +56,7 @@ _DASHBOARD_HTML = """<!doctype html>
 <div id="meta">health: <span id="health">…</span> · refreshed <span id="ts">never</span>
  · JSON: <code>/jobs</code> <code>/workers</code> <code>/queues</code> <code>/supervisor</code>
  <code>/metrics/prom</code> <code>/metrics/history?name=</code> <code>/trace/&lt;job_id&gt;</code>
+ <code>/critical_path/&lt;job_id&gt;</code> <code>/trace/&lt;job_id&gt;/export</code>
  <code>/cost/&lt;job_id&gt;</code> <code>/explain/&lt;job_id&gt;/&lt;subtask_id&gt;</code>
  <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code>
  <code>/alerts</code> <code>/autoscale</code></div>
@@ -60,6 +64,8 @@ _DASHBOARD_HTML = """<!doctype html>
 <th>status</th><th>done</th><th>failed</th><th>pruned</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
 <h2>Latest job trace</h2>
 <div id="trace" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no trace yet</div>
+<h2>Critical path</h2>
+<div id="critpath" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no critical path yet</div>
 <h2>Latest job cost</h2>
 <div id="cost" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no cost data yet</div>
 <h2>Metrics history</h2>
@@ -131,6 +137,42 @@ function renderTrace(el, data){
         `background:${n.attrs && n.attrs.synthesized ? "#9bb8d3" : "#4a7fb5"}"></span></span>` +
         `<span style="width:80px;text-align:right">${((n.end - n.start) * 1000).toFixed(1)} ms</span></div>`;
     }).join("");
+}
+// critical-path waterfall (GET /critical_path/<job_id>): one stacked bar
+// tiling the job wall plus a ranked per-segment table; untraced slices
+// render hatched-gray so coverage gaps are visible, not hidden
+const SEG_COLORS = {
+  "frontend.proxy": "#8e7cc3", "submit.http": "#6fa8dc", submit: "#4a7fb5",
+  expand: "#3d6d9e", "queue.wait": "#e6b84c", place: "#c27ba0",
+  "reclaim.wait": "#b42318", "executor.compile": "#93c47d",
+  "executor.stage": "#76a5af", "executor.dispatch": "#45818e",
+  "executor.fetch": "#6aa84f", execute: "#38761d",
+  "result.ingest": "#a2c4c9", aggregate: "#674ea7", untraced: "#d9d9d9",
+};
+function renderCritPath(el, cp){
+  if (!cp || !cp.segments || !cp.segments.length){
+    el.textContent = "no critical path yet"; return; }
+  const wall = Math.max(cp.wall_s, 1e-9);
+  el.innerHTML =
+    `<div style="color:#666">job <code>${esc(cp.job_id)}</code> · ` +
+    `wall ${(cp.wall_s * 1000).toFixed(1)} ms · coverage ` +
+    `${(100 * cp.coverage).toFixed(1)}% · dominant ` +
+    `<b>${esc((cp.dominant || [])[0] || "")}</b>` +
+    (cp.n_reclaims ? ` · <span class="bad">${esc(cp.n_reclaims)} reclaim(s)</span>` : "") +
+    (cp.speculated ? ` · speculative win` : "") + `</div>` +
+    `<div style="display:flex;height:18px;margin:6px 0;border:1px solid #ccc">` +
+    cp.segments.map(s =>
+      `<span title="${esc(s.name)} ${(s.duration_s * 1000).toFixed(1)} ms" ` +
+      `style="width:${(100 * s.duration_s / wall).toFixed(3)}%;` +
+      `background:${SEG_COLORS[s.name] || "#999"}"></span>`).join("") +
+    `</div>` +
+    `<table><thead><tr><th>segment</th><th>total</th><th>share</th></tr></thead><tbody>` +
+    (cp.dominant || []).map(n =>
+      `<tr><td><span style="display:inline-block;width:10px;height:10px;` +
+      `background:${SEG_COLORS[n] || "#999"}"></span> ${esc(n)}</td>` +
+      `<td>${((cp.totals[n] || 0) * 1000).toFixed(1)} ms</td>` +
+      `<td>${(100 * (cp.totals[n] || 0) / wall).toFixed(1)}%</td></tr>`).join("") +
+    `</tbody></table>`;
 }
 // SI-ish magnitude formatter for FLOP/byte counts
 const fmt = n => n == null ? "\\u2013"
@@ -276,6 +318,8 @@ async function tick(){
   const latest = Array.isArray(jobs) && jobs.length ? jobs[0].job_id : null;
   renderTrace(document.getElementById("trace"),
               latest ? await get(`/trace/${latest}`) : null);
+  renderCritPath(document.getElementById("critpath"),
+                 latest ? await get(`/critical_path/${latest}`) : null);
   renderCost(document.getElementById("cost"),
              latest ? await get(`/cost/${latest}`) : null);
   document.getElementById("ts").textContent = new Date().toLocaleTimeString();
@@ -325,6 +369,10 @@ def create_app(coordinator: Optional[Coordinator] = None):
             Rule("/profile/stop", endpoint="profile_stop", methods=["POST"]),
             Rule("/profile/status", endpoint="profile_status", methods=["GET"]),
             Rule("/trace/<jid>", endpoint="trace", methods=["GET"]),
+            Rule("/trace/<jid>/export", endpoint="trace_export",
+                 methods=["GET"]),
+            Rule("/critical_path/<jid>", endpoint="critical_path_report",
+                 methods=["GET"]),
             Rule("/trace_spans/<wid>", endpoint="trace_spans", methods=["POST"]),
             Rule("/cost/<jid>", endpoint="cost", methods=["GET"]),
             Rule("/healthz", endpoint="healthz", methods=["GET"]),
@@ -406,6 +454,8 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /profile/status",
                     "GET  /metrics/history?name=&since=  (embedded time series)",
                     "GET  /trace/<job_id>  (span tree)",
+                    "GET  /trace/<job_id>/export?format=perfetto|otlp",
+                    "GET  /critical_path/<job_id>[?compare=<job_id>]",
                     "GET  /cost/<job_id>  (device cost report)",
                     "GET  /explain/<job_id>/<subtask_id>  (decision timeline)",
                     "GET  /events?since=&limit=  (flight-recorder firehose)",
@@ -860,6 +910,63 @@ def create_app(coordinator: Optional[Coordinator] = None):
             }
         )
 
+    def trace_export(request, jid):
+        """Export a job's trace as an interchange document
+        (obs/export.py): ``?format=perfetto`` (default — Chrome trace
+        JSON for ui.perfetto.dev / chrome://tracing) or ``?format=otlp``
+        (OTLP-shaped JSON). The document is written under the journal
+        dir (``trace_<trace_id>.<format>.json``) and returned inline;
+        400 on an unknown format, 404 when no trace is bound."""
+        jid = coord.canonical_job_id(jid)
+        tid = TRACER.trace_for_job(jid)
+        if tid is None:
+            return _json(
+                {"status": "error", "message": f"no trace for job {jid!r}"},
+                status=404,
+            )
+        fmt = request.args.get("format", "perfetto")
+        try:
+            out = export_trace(
+                tid,
+                sorted(TRACER.spans_for(tid),
+                       key=lambda s: (s.get("start") or 0)),
+                fmt,
+                job_id=jid,
+            )
+        except ValueError as e:
+            return _json({"status": "error", "message": str(e)}, status=400)
+        return _json(out)
+
+    def critical_path_report(request, jid):
+        """Per-job latency attribution (docs/OBSERVABILITY.md "Critical
+        path & trace export"): the span tree joined with flight-recorder
+        events, tiled into segments that sum to the measured wall.
+        ``?compare=<job_id>`` additionally diffs against that job as the
+        baseline (``diff.delta_wall_s`` > 0 means this job is slower)."""
+        report = coord.critical_path(coord.canonical_job_id(jid))
+        if report is None:
+            return _json(
+                {"status": "error",
+                 "message": f"no critical path for job {jid!r} "
+                            "(no trace bound)"},
+                status=404,
+            )
+        baseline_id = request.args.get("compare")
+        if baseline_id:
+            baseline = coord.critical_path(
+                coord.canonical_job_id(baseline_id)
+            )
+            if baseline is None:
+                return _json(
+                    {"status": "error",
+                     "message": f"no critical path for baseline job "
+                                f"{baseline_id!r}"},
+                    status=404,
+                )
+            report = dict(report)
+            report["diff"] = compare_critical_paths(baseline, report)
+        return _json(report)
+
     def trace_spans(request, wid):
         """Span-shipping ingest for remote agents (runtime/agent.py
         _ship_spans): the return leg of the X-Trace-Id propagation."""
@@ -1076,7 +1183,11 @@ def create_app(coordinator: Optional[Coordinator] = None):
             # trace_spans is the span TRANSPORT — tracing it would append
             # one meta-span to every shipped batch's timeline
             if trace_id and endpoint != "trace_spans" and obs_enabled():
-                with activate(trace_id):
+                # X-Parent-Span: a front end sends its open frontend.proxy
+                # span id so this hop's span nests under it — the stitch
+                # that makes the proxy span the trace's single root
+                parent_id = request.headers.get(PARENT_HEADER)
+                with activate(trace_id, parent_id):
                     with span(f"http.{endpoint}", trace_id=trace_id):
                         resp = handlers[endpoint](request, **values)
             else:

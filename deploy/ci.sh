@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs]
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs|trace]
 #   (default: fast)
 #
 #   fast mode:
@@ -80,6 +80,19 @@
 #   (benchmarks/fleet_health.py) on a real 2-shard fleet through the
 #   front end, refreshing FLEET_HEALTH.json into bench-artifacts/ (the
 #   committed acceptance artifact is benchmarks/FLEET_HEALTH.json).
+#
+#   trace mode (every push in ci.yml, fast): the critical-path /
+#   trace-export gate (docs/OBSERVABILITY.md "Critical path & trace
+#   export") — the engine unit suites (tests/test_critpath.py: exact
+#   segment tiling, untraced-gap honesty, reclaim-wait + speculative-win
+#   attribution, Perfetto/OTLP document shapes, the span-drop counter)
+#   and the two-process stitching suite (tests/test_trace_propagation.py:
+#   frontend.proxy roots the trace, X-Parent-Span nesting) — then the
+#   live attribution drill (benchmarks/critical_path.py: baseline vs
+#   injected-aggregate-slowdown through a real front end; gates segments
+#   ≈ store wall and ≥80 % of the delta attributed), refreshing
+#   CRITICAL_PATH.json into bench-artifacts/ and re-validating the
+#   Perfetto export the drill wrote as loadable Chrome trace JSON.
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -281,6 +294,55 @@ elif [ "$MODE" = "obs" ]; then
   else
     echo "fleet_health drill FAILED (see bench-artifacts/fleet_health.log)"
     tail -n 20 bench-artifacts/fleet_health.log
+    rc=1
+  fi
+elif [ "$MODE" = "trace" ]; then
+  echo "== critical-path / trace-export suites (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_critpath.py tests/test_trace_propagation.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  # live attribution drill: baseline vs injected aggregate slowdown
+  # through a real front end; the fresh JSON is uploaded for
+  # trend-watching (the committed acceptance artifact is
+  # benchmarks/CRITICAL_PATH.json)
+  echo "== critical-path attribution drill (inject -> diff -> attribute) =="
+  mkdir -p bench-artifacts
+  if CRITICAL_PATH_OUT=bench-artifacts/CRITICAL_PATH.json \
+      CS230_JOURNAL_DIR="$ART_DIR/journal" \
+      JAX_PLATFORMS=cpu python benchmarks/critical_path.py \
+      > bench-artifacts/critical_path.log 2>&1; then
+    tail -n 2 bench-artifacts/critical_path.log
+  else
+    echo "critical_path drill FAILED (see bench-artifacts/critical_path.log)"
+    tail -n 20 bench-artifacts/critical_path.log
+    rc=1
+  fi
+  # the drill exports the slowed job's trace as Perfetto Chrome JSON;
+  # re-load it here as an independent validity gate (json.load + the
+  # Chrome-trace keys ui.perfetto.dev requires)
+  echo "== Perfetto export validity gate =="
+  if ! python - <<'PYEOF'
+import json, sys
+
+doc = json.load(open("bench-artifacts/CRITICAL_PATH.json"))
+path = (doc.get("export") or {}).get("perfetto_path")
+if not path:
+    sys.exit("no perfetto_path recorded in CRITICAL_PATH.json")
+trace = json.load(open(path))
+events = trace.get("traceEvents")
+assert isinstance(events, list) and events, "traceEvents missing/empty"
+for e in events:
+    assert "ph" in e and "pid" in e and "name" in e, f"malformed event {e}"
+    if e["ph"] == "X":
+        assert "ts" in e and "dur" in e, f"complete event missing ts/dur {e}"
+print(f"perfetto export ok: {len(events)} events in {path}")
+PYEOF
+  then
+    echo "Perfetto validity gate FAILED"
     rc=1
   fi
 elif [ "$MODE" = "loadtest" ]; then

@@ -139,3 +139,127 @@ def test_trace_stitches_across_agent_round_trip(http_coordinator):
         )
     finally:
         agent.stop()
+
+
+@pytest.fixture()
+def http_fleet(http_coordinator):
+    """The two-process topology: a stateless front end (its own HTTP
+    server) relaying to the coordinator shard — the hop that used to be
+    the tracing blind spot."""
+    from werkzeug.serving import make_server
+
+    from cs230_distributed_machine_learning_tpu.runtime.frontend import (
+        create_frontend_app,
+    )
+
+    coord, url = http_coordinator
+    fe_app = create_frontend_app([url])
+    fe_server = make_server("127.0.0.1", 0, fe_app, threaded=True)
+    fe_thread = threading.Thread(target=fe_server.serve_forever, daemon=True)
+    fe_thread.start()
+    fe_url = f"http://127.0.0.1:{fe_server.server_port}"
+    yield coord, url, fe_url
+    fe_server.shutdown()
+
+
+def _find(nodes, name):
+    for n in nodes:
+        if n["name"] == name:
+            return n
+        hit = _find(n["children"], name)
+        if hit is not None:
+            return hit
+    return None
+
+
+def test_frontend_proxy_span_roots_the_stitched_trace(http_fleet):
+    """A job submitted THROUGH the front end produces one stitched trace
+    whose root is ``frontend.proxy`` with the shard's ``http.train``
+    nested under it: the front end forwards its open span id as
+    X-Parent-Span, records the proxy span into its own tracer, and ships
+    it to the owning shard's /trace_spans ingest."""
+    coord, url, fe_url = http_fleet
+    agent = WorkerAgent(url, poll_timeout_s=0.5, register_backoff_s=0.1)
+    agent.start()
+    try:
+        m = MLTaskManager(url=fe_url)
+        status = m.train(
+            GridSearchCV(LogisticRegression(max_iter=300), {"C": [0.1]}, cv=3),
+            "iris",
+            show_progress=False,
+            timeout=120,
+        )
+        assert status["job_status"] == "completed"
+
+        # poll the stitched trace THROUGH the front end until the shipped
+        # frontend.proxy span landed next to the shard-side chain
+        deadline = time.time() + 10
+        body, names = {}, set()
+        while time.time() < deadline:
+            body = requests.get(
+                f"{fe_url}/trace/{m.job_id}", timeout=10
+            ).json()
+            names = {s["name"] for s in body.get("spans", [])}
+            if {"frontend.proxy", "http.train", "executor.batch"} <= names:
+                break
+            time.sleep(0.2)
+        assert {"frontend.proxy", "http.train", "executor.batch"} <= names, (
+            f"missing {sorted({'frontend.proxy', 'http.train', 'executor.batch'} - names)}"
+        )
+        assert body["trace_id"] == m.trace_id
+
+        # stitching: http.train is NOT a root — it nests under the proxy
+        # span of the relayed /train request
+        roots = {n["name"] for n in body["tree"]}
+        assert "frontend.proxy" in roots
+        assert "http.train" not in roots
+        proxy = next(
+            n for n in body["tree"]
+            if n["name"] == "frontend.proxy"
+            and _find(n["children"], "http.train") is not None
+        )
+        assert proxy["attrs"]["route"] == "train"
+        assert proxy["attrs"]["shard"] == 0
+        assert proxy["attrs"]["minted"] is False  # client sent the id
+        assert proxy["process"].startswith("frontend:")
+
+        # the trace response relayed the id end to end
+        r = requests.get(
+            f"{fe_url}/trace/{m.job_id}",
+            headers={"X-Trace-Id": m.trace_id},
+            timeout=10,
+        )
+        assert r.headers.get("X-Trace-Id") == m.trace_id
+
+        # a headerless relayed request gets a MINTED trace id echoed back
+        r = requests.get(f"{fe_url}/trace/{m.job_id}", timeout=10)
+        minted = r.headers.get("X-Trace-Id")
+        assert minted and minted != m.trace_id
+
+        # the critical-path report is reachable through the front end and
+        # starts at the proxy hop
+        deadline = time.time() + 10
+        cp = {}
+        while time.time() < deadline:
+            cp = requests.get(
+                f"{fe_url}/critical_path/{m.job_id}", timeout=10
+            ).json()
+            if cp.get("segments") and cp["segments"][0]["name"] == "frontend.proxy":
+                break
+            time.sleep(0.2)
+        assert cp["segments"][0]["name"] == "frontend.proxy"
+        assert sum(s["duration_s"] for s in cp["segments"]) == pytest.approx(
+            cp["wall_s"], rel=1e-6
+        )
+
+        # and the Perfetto export routes by the job stamp too
+        exp = requests.get(
+            f"{fe_url}/trace/{m.job_id}/export?format=perfetto", timeout=10
+        ).json()
+        assert exp["format"] == "perfetto"
+        assert any(
+            e.get("name") == "frontend.proxy"
+            for e in exp["document"]["traceEvents"]
+        )
+    finally:
+        agent.stop()
