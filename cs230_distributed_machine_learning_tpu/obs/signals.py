@@ -165,6 +165,19 @@ class CapacitySignals:
             or util >= 1.0
             or (svc.route_p99_slo_s > 0 and p99 > svc.route_p99_slo_s)
         )
+        # numeric per-shard pressure (the migration/steal trigger,
+        # docs/ROBUSTNESS.md "Shard rebalancing"): dimensionless sum of
+        # (a) backlog expressed in drain-horizons, (b) admission-cap
+        # utilization, (c) a flat +1 while the shard is BURNING 429s —
+        # rejecting work is hot no matter what the backlog arithmetic
+        # says. 0 ≈ idle, ≥1 ≈ busy, ≥rebalance_hot_pressure ≈ shed load.
+        horizon_v = max(float(svc.autoscale_horizon_s), 1e-6)
+        shard_pressure = round(
+            backlog_total_s / horizon_v
+            + util
+            + (1.0 if (reject_rate or 0.0) > 0.0 else 0.0),
+            4,
+        )
 
         # ---- desired workers ----
         horizon = max(float(svc.autoscale_horizon_s), 1e-6)
@@ -205,6 +218,7 @@ class CapacitySignals:
             g("tpuml_autoscale_backlog_seconds").set(
                 float(backlog_total_s)
             )
+            g("tpuml_shard_pressure").set(float(shard_pressure))
 
         rep: Dict[str, Any] = {
             "desired_workers": desired_workers,
@@ -227,6 +241,7 @@ class CapacitySignals:
                 "total_devices": total_devices,
                 "idle_workers": len(idle_workers),
                 "pressure": pressure,
+                "shard_pressure": shard_pressure,
             },
             "hysteresis": {
                 "raw_desired_workers": raw_workers,

@@ -47,6 +47,13 @@ TOPIC_RESULTS = "result"
 TOPIC_METRICS = "metrics"
 
 
+class JobMigratedError(Exception):
+    """Raised inside a job's ingest loop when the rebalancer has marked
+    the job for migration: the loop unwinds WITHOUT finalizing (the
+    destination shard owns completion now) and without the generic
+    failure path (nothing failed — the job moved)."""
+
+
 class Coordinator:
     def __init__(
         self,
@@ -119,6 +126,18 @@ class Coordinator:
             default_rules(self.config),
             interval_s=self.config.service.alert_eval_interval_s,
         )
+        #: peer shard base URLs, index == shard id (server --peers /
+        #: ShardFleet). Empty on unsharded deployments — every
+        #: rebalancing path below is inert without peers.
+        self.peer_urls: List[str] = []
+        #: jobs being quiesced for migration: (sid, jid) -> dest shard.
+        #: The scheduled ingest loop checks this each iteration and
+        #: unwinds via JobMigratedError — the quiesce half of the
+        #: migration state machine (docs/ROBUSTNESS.md).
+        self._migrating: Dict[tuple, int] = {}
+        self._rebalance_lock = threading.Lock()
+        self._rebalance_busy = False
+        self._last_rebalance = 0.0
         if cluster is not None:
             # journal every attempt issue (lease reclaim / retry / requeue /
             # speculation) into the job store so replay preserves budgets,
@@ -151,6 +170,10 @@ class Coordinator:
             self.alerts.evaluate(force=force)
         except Exception:  # noqa: BLE001
             logger.exception("Alert-rule evaluation failed")
+        try:
+            self.rebalance_tick()
+        except Exception:  # noqa: BLE001 — rebalancing must never break a caller
+            logger.exception("Rebalance tick failed")
 
     def _recover(self) -> None:
         """Boot-time crash recovery: surface the journal replay the store
@@ -305,6 +328,638 @@ class Coordinator:
             resumed.append(job_id)
         return resumed
 
+    # ------------- cross-shard rebalancing (docs/ROBUSTNESS.md "Shard rebalancing") -------------
+    # The fleet acting on its own telemetry: a HOT shard (high
+    # tpuml_shard_pressure) migrates whole jobs to a drainable-COLD peer
+    # and offers queued subtasks to thieves; an idle shard steals. Both
+    # paths ride the existing crash-safety machinery — journal ops with
+    # total replay, attempt-stamp fencing, first-terminal-result-wins
+    # dedup — so a SIGKILL of either party at any phase loses nothing.
+
+    def rebalance_tick(self) -> None:
+        """Throttled entry point, driven by health_tick (engine sweep /
+        scrapes). The actual pass runs on a background thread — it makes
+        peer HTTP probes and must never stall a sweep."""
+        svc = self.config.service
+        if (
+            not svc.rebalance_enabled
+            or self.cluster is None
+            or self.shard_id is None
+            or not self.peer_urls
+            or not self.ready
+        ):
+            return
+        now = time.time()
+        with self._rebalance_lock:
+            if (
+                self._rebalance_busy
+                or now - self._last_rebalance < svc.rebalance_interval_s
+            ):
+                return
+            self._rebalance_busy = True
+            self._last_rebalance = now
+        threading.Thread(target=self._rebalance_once, daemon=True).start()
+
+    def _rebalance_once(self) -> None:
+        try:
+            self._reclaim_stale_steals()
+            rep = self.signals.evaluate()
+            sig = rep.get("signals") or {}
+            my_p = float(sig.get("shard_pressure") or 0.0)
+            svc = self.config.service
+            if my_p >= svc.rebalance_hot_pressure:
+                self._migrate_if_peer_cold(my_p)
+            elif (
+                my_p <= svc.rebalance_cold_pressure
+                and int(sig.get("idle_workers") or 0) > 0
+            ):
+                self._steal_from_hot_peer()
+        except Exception:  # noqa: BLE001 — a failed pass must not wedge the next
+            logger.exception("Rebalance pass failed")
+        finally:
+            with self._rebalance_lock:
+                self._rebalance_busy = False
+
+    def _peer_pressures(self) -> Dict[int, float]:
+        """shard_pressure of every answering peer (short timeouts — a
+        dead peer is simply not a candidate)."""
+        import requests
+
+        out: Dict[int, float] = {}
+        for k, url in enumerate(self.peer_urls):
+            if k == self.shard_id or not url:
+                continue
+            try:
+                r = requests.get(f"{url}/autoscale", timeout=3)
+                if r.ok:
+                    sig = (r.json() or {}).get("signals") or {}
+                    out[k] = float(sig.get("shard_pressure") or 0.0)
+            except (requests.RequestException, ValueError):
+                continue
+        return out
+
+    def _migrate_if_peer_cold(self, my_pressure: float) -> None:
+        svc = self.config.service
+        peers = self._peer_pressures()
+        if not peers:
+            return
+        dest, cold = min(peers.items(), key=lambda kv: kv[1])
+        if cold > svc.rebalance_cold_pressure:
+            return
+        if cold > 0 and my_pressure / cold < svc.rebalance_imbalance_ratio:
+            return  # hot, but not hot ENOUGH relative to the peer
+        picked = self._pick_migratable()
+        if picked is None:
+            return
+        sid, jid = picked
+        self.migrate_job(sid, jid, dest)
+
+    def _pick_migratable(self) -> Optional[tuple]:
+        """Cheapest unfinished job that can move: not mid-expansion, not
+        already migrating, and not an adaptive-search job (the rung
+        controller's in-memory ladder state has no export contract — a
+        migrated ASHA job would restart its schedule from the journaled
+        rung history on the WRONG shard's recorder; excluded by design,
+        documented in docs/ROBUSTNESS.md). Among the eligible, a job with
+        nothing currently EXECUTING (no subtask at the head of a worker
+        queue — the same queued-vs-running line the steal offer draws)
+        wins: quiescing it fences only queued attempts and throws away no
+        in-flight work. A job mid-execution is the fallback, not the
+        first pick."""
+        heads = set()
+        if self.cluster is not None:
+            for q in self.cluster.engine.queue_snapshot().values():
+                if q:
+                    heads.add(q[0])
+        fallback: Optional[tuple] = None
+        for sid, jid in self.store.unfinished_jobs():
+            if (sid, jid) in self._migrating:
+                continue
+            with self._submit_lock:
+                if jid in self._submitting:
+                    continue
+            try:
+                job = self.store.get_job(sid, jid)
+            except KeyError:
+                continue
+            subs = job.get("subtasks") or {}
+            if any((s.get("spec") or {}).get("asha") for s in subs.values()):
+                continue
+            # anti-ping-pong: a job migrates at most once. Re-exporting
+            # an adopted job would let two shards trade the same job
+            # every tick while both hover near the hot threshold.
+            if job.get("migrated_from") is not None:
+                continue
+            live = [
+                stid for stid, s in subs.items()
+                if s["status"] not in SUBTASK_TERMINAL_STATUSES
+            ]
+            if not live:
+                continue
+            if not any(stid in heads for stid in live):
+                return sid, jid
+            if fallback is None:
+                fallback = (sid, jid)
+        return fallback
+
+    def migrate_job(self, sid: str, job_id: str, dest_shard: int) -> bool:
+        """Donor half of the migration state machine:
+
+        1. **quiesce** — mark the job migrating; its ingest loop unwinds
+           (JobMigratedError) without finalizing.
+        2. **fence** — bump every non-terminal subtask's attempt
+           (journaled via the on_attempt hook) and release its engine
+           book entry: no donor-side copy can re-dispatch, and any
+           still-executing worker's late FAILED report is stale by
+           construction (its COMPLETED is still accepted — at-least-once).
+        3. **export** — POST the full job record to the peer's
+           ``/migrate_in``; the RECIPIENT journals ``migrate_in`` first.
+        4. **stamp** — only after the peer accepted, journal
+           ``migrate_out`` (the forwarding stamp). Crash between 3 and 4
+           leaves BOTH shards owning the job: clients still route to the
+           donor (no stamp), so results stay consistent and the
+           recipient's copy is wasted work deduped by first-wins — never
+           a lost job. Crash before 3 (or a failed POST) aborts and the
+           job respawns locally.
+        5. **forward** — replay-forward late donor-side results to the
+           new owner for ``rebalance_forward_s``.
+        """
+        import os as _os
+
+        import requests
+
+        if self.cluster is None or not self.peer_urls:
+            return False
+        try:
+            url = self.peer_urls[int(dest_shard)]
+        except (IndexError, ValueError):
+            return False
+        record_event(
+            "migrate.start", job_id=job_id, dest_shard=int(dest_shard),
+        )
+        self._migrating[(sid, job_id)] = int(dest_shard)
+        try:
+            t = self._job_threads.get(job_id)
+            if t is not None and t.is_alive():
+                t.join(timeout=30.0)
+                if t.is_alive():
+                    record_event(
+                        "migrate.abort", job_id=job_id,
+                        dest_shard=int(dest_shard),
+                        reason="quiesce_timeout",
+                    )
+                    return False  # loop never unwound: leave the job alone
+            # ---- fence ----
+            job = self.store.get_job(sid, job_id)
+            owner = {
+                stid: wid
+                for wid, q in self.cluster.engine.queue_snapshot().items()
+                for stid in q
+            }
+            fenced = 0
+            for stid, sub in job["subtasks"].items():
+                if sub["status"] in SUBTASK_TERMINAL_STATUSES:
+                    continue
+                task = dict(sub["spec"])
+                self.cluster.ledger.seed(task)
+                self.cluster.ledger.next_attempt(task, reason="migrate")
+                wid = owner.get(stid) or task.get("placed_worker")
+                if wid:
+                    self.cluster.engine.release_task(wid, stid)
+                self.store.clear_steal(stid)
+                fenced += 1
+            # ---- export (re-read: the fence journaled fresh attempts
+            # into the specs, and the recipient must adopt THOSE) ----
+            job = self.store.get_job(sid, job_id)
+            export = {
+                "session_id": sid,
+                "priority": self.store.session_priority(sid),
+                "source_shard": self.shard_id,
+                "job": job,
+            }
+            try:
+                r = requests.post(
+                    f"{url}/migrate_in", json=json_safe(export), timeout=30
+                )
+            except requests.RequestException as e:
+                self._abort_migration(sid, job_id, f"peer_unreachable: {e}")
+                return False
+            if r.status_code != 200:
+                self._abort_migration(
+                    sid, job_id, f"peer_rejected: HTTP {r.status_code}"
+                )
+                return False
+            # chaos-drill hook: hold the riskiest window (recipient has
+            # the job, donor not yet stamped) open so the harness can
+            # land a deterministic SIGKILL inside it
+            delay = float(_os.environ.get("CS230_MIGRATE_DELAY_S", 0) or 0)
+            if delay > 0:
+                time.sleep(delay)
+            # ---- stamp ----
+            self.store.record_migrate_out(sid, job_id, int(dest_shard))
+            counter_inc("tpuml_jobs_migrated_total", direction="out")
+            record_event(
+                "migrate.out", job_id=job_id, dest_shard=int(dest_shard),
+                n_fenced=fenced,
+            )
+            logger.info(
+                "Migrated job %s to shard %d (%d subtasks fenced)",
+                job_id, int(dest_shard), fenced,
+            )
+            # ---- forward late results ----
+            pending = [
+                stid for stid, sub in job["subtasks"].items()
+                if sub["status"] not in SUBTASK_TERMINAL_STATUSES
+            ]
+            self._forward_late_results(job_id, int(dest_shard), pending)
+            self.cluster.ledger.forget(list(job["subtasks"]))
+            return True
+        finally:
+            self._migrating.pop((sid, job_id), None)
+
+    def _abort_migration(self, sid: str, job_id: str, reason: str) -> None:
+        """Failed export: the job never left. Clear the quiesce mark and
+        respawn it locally — the fenced attempts simply re-dispatch here
+        (same recovery semantics as a restart)."""
+        record_event("migrate.abort", job_id=job_id, reason=reason)
+        logger.warning("Migration of job %s aborted: %s", job_id, reason)
+        self._migrating.pop((sid, job_id), None)
+        self._respawn_job(sid, job_id)
+
+    def _respawn_job(self, sid: str, job_id: str) -> None:
+        """Resume ONE job from its store record (the per-job slice of
+        resume_inflight): dispatch what isn't terminal, keep what is."""
+        job = self.store.get_job(sid, job_id)
+        specs = [sub["spec"] for sub in job["subtasks"].values()]
+        existing = {
+            stid: sub["result"]
+            for stid, sub in job["subtasks"].items()
+            if sub["status"] in SUBTASK_TERMINAL_STATUSES and sub["result"]
+        }
+        t = threading.Thread(
+            target=self._run_job,
+            args=(sid, job_id, specs),
+            kwargs={"existing": existing},
+            daemon=True,
+        )
+        self._job_threads[job_id] = t
+        t.start()
+
+    def _forward_late_results(
+        self, job_id: str, dest_shard: int, pending_ids: List[str]
+    ) -> None:
+        """Donor-side replay-forward: results for a migrated job's
+        still-open subtasks (zombie workers finishing fenced attempts)
+        are POSTed to the new owner's ``/peer_result`` for a bounded
+        window, so the at-least-once ingest contract survives the
+        handoff — the recipient's first-wins dedup absorbs any overlap
+        with its own re-dispatched attempts."""
+        if not pending_ids:
+            return
+        import queue as _q
+
+        import requests
+
+        url = self.peer_urls[dest_shard]
+        wanted = set(pending_ids)
+        sub = self.bus.subscribe(
+            TOPIC_RESULTS, key_filter=lambda k: k in wanted
+        )
+        deadline = time.time() + self.config.service.rebalance_forward_s
+
+        def _pump():
+            # one successful relay per subtask: duplicate reports (a
+            # worker re-sending, or the recipient echoing a stolen
+            # result we already forwarded) must not re-post, or a
+            # migrated-after-steal subtask ping-pongs between the two
+            # shards until both relay deadlines expire
+            done: set = set()
+            try:
+                while time.time() < deadline and len(done) < len(wanted):
+                    try:
+                        stid, result = sub.get(timeout=1.0)
+                    except _q.Empty:
+                        continue
+                    if stid in done:
+                        continue
+                    try:
+                        requests.post(
+                            f"{url}/peer_result",
+                            json=json_safe(result or {}),
+                            timeout=10,
+                        )
+                        done.add(stid)
+                        counter_inc("tpuml_results_forwarded_total")
+                        record_event(
+                            "migrate.forward", job_id=job_id,
+                            subtask_id=stid, dest_shard=dest_shard,
+                        )
+                    except requests.RequestException:
+                        logger.warning(
+                            "Forwarding late result %s to shard %d failed",
+                            stid, dest_shard,
+                        )
+            finally:
+                sub.close()
+
+        threading.Thread(target=_pump, daemon=True).start()
+
+    def migrate_in(self, export: Dict[str, Any]) -> Dict[str, Any]:
+        """Recipient half: journal the adopted record (``migrate_in`` —
+        BEFORE the donor stamps ``migrate_out``, so no crash ordering
+        loses the job), then resume it like a recovered local job. A
+        duplicate POST (donor retry) is answered idempotently."""
+        if self.cluster is None:
+            raise ValueError(
+                "job migration requires a clustered coordinator"
+            )
+        job = (export or {}).get("job") or {}
+        sid = (export or {}).get("session_id")
+        job_id = job.get("job_id")
+        if not (sid and job_id and job.get("subtasks") is not None):
+            raise ValueError("malformed migration export")
+        if self.store.has_job(sid, job_id):
+            return {
+                "status": "accepted", "job_id": job_id,
+                "shard": self.shard_id, "duplicate": True,
+            }
+        src = export.get("source_shard")
+        self.store.create_session(
+            sid, priority=int(export.get("priority") or 0)
+        )
+        self.store.import_job(sid, job, source_shard=src)
+        counter_inc("tpuml_jobs_migrated_total", direction="in")
+        record_event(
+            "migrate.in", job_id=job_id, source_shard=src,
+            n_subtasks=len(job.get("subtasks") or {}),
+        )
+        logger.info(
+            "Adopted job %s from shard %s (%d subtasks)",
+            job_id, src, len(job.get("subtasks") or {}),
+        )
+        self._respawn_job(sid, job_id)
+        return {
+            "status": "accepted", "job_id": job_id, "shard": self.shard_id,
+        }
+
+    # ---- work stealing ----
+
+    def steal_candidates(self) -> Dict[str, Any]:
+        """Donor surface (``GET /steal_candidates``): queued, steal-
+        eligible subtasks an idle peer may pull — offered only while this
+        shard is HOT (a balanced fleet advertises nothing). Per-worker
+        queue heads are withheld (likely already executing), as are
+        tombstoned (already-granted) and adaptive-search subtasks."""
+        out: Dict[str, Any] = {
+            "shard": self.shard_id,
+            "candidates": [],
+            "shard_pressure": None,
+            "backlog_device_seconds": None,
+        }
+        if self.cluster is None or not self.config.service.rebalance_enabled:
+            return out
+        sig = (self.signals.report() or {}).get("signals") or {}
+        out["shard_pressure"] = sig.get("shard_pressure")
+        out["backlog_device_seconds"] = sig.get("backlog_device_seconds")
+        if (
+            float(sig.get("shard_pressure") or 0.0)
+            < self.config.service.rebalance_hot_pressure
+        ):
+            return out
+        tomb = dict(self.store.steal_tombstones)
+        queued = [
+            stid
+            for q in self.cluster.engine.queue_snapshot().values()
+            for stid in q[1:]
+            if stid not in tomb
+        ]
+        info = self.store.lookup_specs(queued)
+        for stid, rec in info.items():
+            spec = rec["spec"]
+            if spec.get("asha"):
+                continue
+            out["candidates"].append(
+                {
+                    "subtask_id": stid,
+                    "job_id": rec["job_id"],
+                    "session_id": rec["session_id"],
+                    "est_s": spec.get("est_s"),
+                }
+            )
+        return out
+
+    def release_for_steal(
+        self, thief_shard: int, max_n: int
+    ) -> List[Dict[str, Any]]:
+        """Donor grant (``POST /steal_tasks``): hand up to ``max_n``
+        queued subtasks to a thief shard as FRESH ledger attempts. Each
+        grant bumps the attempt (fencing the queued donor copy — its
+        late FAILED is stale, its late COMPLETED still wins first),
+        releases the engine book entry, and journals a ``steal``
+        tombstone so neither a live nor a restarted donor re-dispatches
+        the subtask inside the steal lease."""
+        if (
+            self.cluster is None
+            or not self.config.service.rebalance_enabled
+            or max_n <= 0
+        ):
+            return []
+        tomb = dict(self.store.steal_tombstones)
+        owner = {
+            stid: wid
+            for wid, q in self.cluster.engine.queue_snapshot().items()
+            for stid in q[1:]
+            if stid not in tomb
+        }
+        info = self.store.lookup_specs(list(owner))
+        granted: List[Dict[str, Any]] = []
+        for stid, rec in info.items():
+            if len(granted) >= int(max_n):
+                break
+            if rec["spec"].get("asha"):
+                continue
+            task = dict(rec["spec"])
+            self.cluster.ledger.seed(task)
+            self.cluster.ledger.next_attempt(task, reason="steal")
+            self.cluster.engine.release_task(owner[stid], stid)
+            self.store.record_steal(
+                rec["session_id"], rec["job_id"], stid,
+                thief_shard=int(thief_shard),
+                attempt=int(task.get("attempt") or 0),
+            )
+            task["metadata"] = rec["metadata"]
+            task["stolen_from"] = self.shard_id
+            granted.append(task)
+            counter_inc("tpuml_subtasks_stolen_total", direction="out")
+            record_event(
+                "steal.out", job_id=rec["job_id"], subtask_id=stid,
+                attempt=int(task.get("attempt") or 0),
+                thief_shard=int(thief_shard),
+            )
+        if granted:
+            logger.info(
+                "Granted %d queued subtasks to thief shard %d",
+                len(granted), int(thief_shard),
+            )
+        return granted
+
+    def _steal_from_hot_peer(self) -> None:
+        """Thief half: poll peers' ``/steal_candidates``, pull from the
+        hottest offering shard, run the grants on the local fabric, and
+        relay every result back to the donor's ``/peer_result`` (the
+        donor's still-running ingest loop counts them — its ledger
+        expects exactly the granted attempt)."""
+        import requests
+
+        svc = self.config.service
+        offers: Dict[int, Dict[str, Any]] = {}
+        for k, url in enumerate(self.peer_urls):
+            if k == self.shard_id or not url:
+                continue
+            try:
+                r = requests.get(f"{url}/steal_candidates", timeout=3)
+                if r.ok:
+                    body = r.json() or {}
+                    if body.get("candidates"):
+                        offers[k] = body
+            except (requests.RequestException, ValueError):
+                continue
+        if not offers:
+            return
+        donor = max(
+            offers,
+            key=lambda k: float(offers[k].get("shard_pressure") or 0.0),
+        )
+        try:
+            r = requests.post(
+                f"{self.peer_urls[donor]}/steal_tasks",
+                json={
+                    "thief_shard": self.shard_id,
+                    "max_n": int(svc.steal_max_tasks),
+                },
+                timeout=10,
+            )
+        except requests.RequestException:
+            return
+        if not r.ok:
+            return
+        try:
+            tasks = (r.json() or {}).get("tasks") or []
+        except ValueError:
+            return
+        if tasks:
+            self._run_stolen(donor, tasks)
+
+    def _run_stolen(
+        self, donor_shard: int, tasks: List[Dict[str, Any]]
+    ) -> None:
+        """Execute stolen grants on this shard's fabric and relay the
+        results home. The thief journals nothing — if it dies, the
+        donor's steal lease expires and reclaims the subtasks with a
+        fresh (fencing) attempt, so a resurrected thief's late result is
+        deduped, never double-counted."""
+        import queue as _q
+
+        import requests
+
+        url = self.peer_urls[donor_shard]
+        wanted = {t["subtask_id"] for t in tasks if t.get("subtask_id")}
+        sub = self.bus.subscribe(
+            TOPIC_RESULTS, key_filter=lambda k: k in wanted
+        )
+        for t in tasks:
+            counter_inc("tpuml_subtasks_stolen_total", direction="in")
+            record_event(
+                "steal.in", job_id=t.get("job_id"),
+                subtask_id=t.get("subtask_id"),
+                attempt=int(t.get("attempt") or 0),
+                donor_shard=donor_shard,
+            )
+        logger.info(
+            "Stole %d queued subtasks from shard %d", len(tasks), donor_shard
+        )
+        self.cluster.submit([dict(t) for t in tasks])
+
+        def _pump():
+            deadline = time.time() + 20.0 * self.config.service.client_timeout_s
+            pending = set(wanted)
+            try:
+                while pending and time.time() < deadline:
+                    try:
+                        stid, result = sub.get(timeout=1.0)
+                    except _q.Empty:
+                        continue
+                    if stid not in pending:
+                        # echo of an already-relayed result (the donor
+                        # forward-relays it back here if it migrated the
+                        # job after granting the steal) — re-posting
+                        # would ping-pong it between the shards
+                        continue
+                    try:
+                        requests.post(
+                            f"{url}/peer_result",
+                            json=json_safe(result or {}),
+                            timeout=10,
+                        )
+                        pending.discard(stid)
+                    except requests.RequestException:
+                        logger.warning(
+                            "Relaying stolen result %s to shard %d failed",
+                            stid, donor_shard,
+                        )
+            finally:
+                sub.close()
+                self.cluster.ledger.forget(wanted)
+
+        threading.Thread(target=_pump, daemon=True).start()
+
+    def _reclaim_stale_steals(self) -> None:
+        """Donor lease sweep: a tombstone older than ``steal_lease_s``
+        whose subtask is still open means the thief went dark — reclaim
+        with a fresh attempt (fencing any resurrected thief) and
+        re-dispatch locally; the job's still-running ingest loop picks
+        the result up by subtask id."""
+        svc = self.config.service
+        now = time.time()
+        for stid, t in list(self.store.steal_tombstones.items()):
+            if now - float(t.get("ts") or 0) < svc.steal_lease_s:
+                continue
+            self.store.clear_steal(stid)
+            info = self.store.lookup_specs([stid])
+            if stid not in info:
+                continue  # already terminal: nothing to reclaim
+            rec = info[stid]
+            task = dict(rec["spec"])
+            self.cluster.ledger.seed(task)
+            self.cluster.ledger.next_attempt(task, reason="steal_reclaim")
+            task["metadata"] = rec["metadata"]
+            counter_inc(
+                "tpuml_subtasks_retried_total", reason="steal_reclaim"
+            )
+            record_event(
+                "steal.reclaim", job_id=rec["job_id"], subtask_id=stid,
+                attempt=int(task.get("attempt") or 0),
+                thief_shard=t.get("thief"),
+            )
+            logger.warning(
+                "Steal lease expired for %s (thief shard %s): reclaimed",
+                stid, t.get("thief"),
+            )
+            self.cluster.submit([task])
+
+    def ingest_peer_result(self, result: Dict[str, Any]) -> None:
+        """``POST /peer_result``: a peer shard handing back a result —
+        a thief returning a stolen grant, or a donor replay-forwarding a
+        late result for a migrated job. Published onto the local result
+        topic keyed by subtask id; the owning job loop applies the exact
+        same first-wins / stale-attempt rules as any worker result."""
+        result = dict(result or {})
+        stid = result.get("subtask_id")
+        if not stid:
+            return
+        counter_inc("tpuml_peer_results_ingested_total")
+        self.bus.publish(TOPIC_RESULTS, result, key=stid)
+
     # ------------- admission control (docs/ROBUSTNESS.md "Overload") -------------
 
     def admission_check(self, sid: Optional[str] = None) -> Optional[Dict[str, Any]]:
@@ -401,6 +1056,12 @@ class Coordinator:
         idempotent-resubmit dedupe survives sharding); already-stamped
         and unsharded ids pass through."""
         if self.shard_id is None or not job_id:
+            return job_id
+        # a job adopted from a donor shard keeps the DONOR's stamp —
+        # re-wrapping (stamp_job_id wraps foreign-looking stamps by
+        # design) would mint an id this shard never stored and every
+        # status poll on the migrated job would 404
+        if self.store.is_adopted_job(job_id):
             return job_id
         from .sharding import stamp_job_id
 
@@ -679,6 +1340,11 @@ class Coordinator:
                         ),
                     )
             counter_inc("tpuml_jobs_completed_total")
+        except JobMigratedError:
+            # not a failure: the job left this shard mid-flight. The
+            # migration driver (migrate_job) owns the rest of the
+            # handoff; finalization happens on the destination shard.
+            logger.info("Job %s quiesced for migration", job_id)
         except Exception as e:  # noqa: BLE001
             logger.exception("Job %s failed", job_id)
             counter_inc("tpuml_jobs_failed_total")
@@ -736,6 +1402,12 @@ class Coordinator:
             hard_deadline = time.time() + 20.0 * stall_grace
             last_progress = time.time()
             while pending:
+                # quiesce gate: the rebalancer marked this job for
+                # migration — unwind without finalizing; the migration
+                # driver fences the remaining attempts and the
+                # destination shard finishes the job
+                if self._migrating.get((sid, job_id)) is not None:
+                    raise JobMigratedError(job_id)
                 now = time.time()
                 if now > hard_deadline:
                     raise TimeoutError(
@@ -758,6 +1430,10 @@ class Coordinator:
                         }  # backoff-parked retries count as owned
                         for q in self.cluster.engine.queue_snapshot().values():
                             owned.update(q)
+                        # subtasks granted to a thief shard are owned
+                        # remotely: the steal lease (not this stall
+                        # check) reclaims them if the thief goes dark
+                        owned.update(self.store.steal_tombstones)
                         if not (pending & owned):
                             raise TimeoutError(
                                 f"{len(pending)} subtasks stalled with no live "
@@ -767,6 +1443,10 @@ class Coordinator:
                         last_progress = time.time()  # workers still own tasks
                     continue
                 result = result or {}
+                # any result settles an outstanding steal grant for this
+                # subtask (terminal → done; failed → back in the local
+                # retry path below)
+                self.store.clear_steal(stid)
                 if stid not in pending:
                     # duplicate delivery: a requeue race, the losing copy
                     # of a speculative pair, or a zombie attempt from

@@ -105,6 +105,11 @@ class ShardFleet:
             "--host", self.host, "--port", str(self.shard_ports[k]),
             "--shard-index", str(k), "--num-shards", str(self.n_shards),
             "--local-executors", str(self.local_executors),
+            # peer directory for cross-shard rebalancing: ports are
+            # allocated in __init__ (stable across restart_shard), so
+            # the list is correct even before peers are up. Inert unless
+            # service.rebalance_enabled is set in the fleet env.
+            "--peers", ",".join(self.shard_urls),
         ]
         if self.journal:
             cmd.append("--journal")

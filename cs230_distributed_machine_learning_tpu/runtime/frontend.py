@@ -47,6 +47,7 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..obs import counter_inc, render_prometheus
 from ..obs.tracing import (
     PARENT_HEADER,
     TRACE_HEADER,
@@ -57,7 +58,7 @@ from ..obs.tracing import (
 )
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
-from .sharding import id_shard, shard_of
+from .sharding import ForwardingCache, id_shard, shard_of
 
 logger = get_logger("tpuml.frontend")
 
@@ -144,6 +145,12 @@ def create_frontend_app(shard_urls: List[str]):
 
     #: round-robin cursor for /subscribe shard assignment
     _rr = itertools.count()
+
+    #: migrated-job redirect cache (docs/ROBUSTNESS.md "Shard
+    #: rebalancing"): a donor's 409 forwarding stamp is remembered here
+    #: so subsequent requests for the job proxy straight to the new
+    #: owner instead of paying the probe-then-redirect round trip
+    fwd_cache = ForwardingCache()
 
     # one shared pool for every fan-out route (/healthz, /jobs,
     # /metrics/prom, ...): these are POLLED endpoints, and spawning +
@@ -254,11 +261,42 @@ def create_frontend_app(shard_urls: List[str]):
         )
 
     def _proxy(request, k: int, path: str, *, body: Optional[bytes] = None,
-               stream: bool = False) -> Response:
+               stream: bool = False, job_id: Optional[str] = None) -> Response:
+        # migrated-job fast path: a cached forwarding stamp overrides the
+        # hash/stamp route — the donor would only answer 409 moved anyway
+        if job_id is not None:
+            cached = fwd_cache.get(job_id)
+            if cached is not None and 0 <= cached < n_shards:
+                k = cached
         try:
             upstream = _upstream(request, k, path, body=body, stream=stream)
         except requests.RequestException:
             return _shard_down(k)
+        if job_id is not None and upstream.status_code == 409:
+            # the forwarding stamp (server.py _moved): learn the move,
+            # then re-proxy ONCE to the new owner. Bodies on these routes
+            # are small (werkzeug caches get_data), so the resend is safe.
+            try:
+                moved = upstream.json()
+            except ValueError:
+                moved = None
+            if isinstance(moved, dict) and moved.get("status") == "moved":
+                upstream.close()
+                try:
+                    dest = int(moved.get("migrated_to"))
+                except (TypeError, ValueError):
+                    dest = -1
+                if 0 <= dest < n_shards and dest != k:
+                    fwd_cache.put(str(moved.get("job_id") or job_id), dest)
+                    counter_inc("tpuml_frontend_forwarded_total")
+                    try:
+                        upstream = _upstream(
+                            request, dest, path, body=body, stream=stream
+                        )
+                    except requests.RequestException:
+                        return _shard_down(dest)
+                    return _relay(upstream, stream=stream)
+                return _json(moved, status=409)
         return _relay(upstream, stream=stream)
 
     def _fan_json(request, path: str) -> Dict[int, Any]:
@@ -379,6 +417,11 @@ def create_frontend_app(shard_urls: List[str]):
                 return k, None
 
         bodies = list(fan_pool.map(_scrape, range(n_shards)))
+        # the front end's OWN registry (tpuml_frontend_forwarded_total,
+        # ...) lives in this process, invisible to every shard scrape —
+        # appended under shard="frontend" so the fleet exposition is
+        # still one scrape
+        bodies.append(("frontend", render_prometheus()))
         lines: List[str] = []
         seen_meta = set()
         for k, text in bodies:
@@ -516,9 +559,28 @@ def create_frontend_app(shard_urls: List[str]):
         desired_shards is the MAX of the per-shard recommendations (each
         shard sizes the whole fleet from its own saturation — the most
         pressured shard's view wins), with the per-shard bodies attached
-        for attribution."""
+        for attribution. Also names WHICH shard is hot: the per-shard
+        ``shard_pressure`` map, the argmax (``hot_shard``) and the
+        max/mean ``imbalance_ratio`` — the external autoscaler's skew
+        signal (a high ratio with low fleet totals means rebalance, not
+        scale-out; docs/ROBUSTNESS.md "Shard rebalancing")."""
         shards = _fan_json(request, request.path)
         bodies = {k: (shards[k] or {}) for k in shards}
+        pressures: Dict[int, float] = {}
+        for k, b in bodies.items():
+            sp = (b.get("signals") or {}).get("shard_pressure")
+            if sp is not None:
+                pressures[k] = float(sp)
+        hot_shard = (
+            max(pressures, key=lambda k: pressures[k]) if pressures else None
+        )
+        mean_p = (
+            sum(pressures.values()) / len(pressures) if pressures else 0.0
+        )
+        imbalance = (
+            round(max(pressures.values()) / mean_p, 4)
+            if pressures and mean_p > 1e-9 else None
+        )
         return _json({
             "desired_workers": sum(
                 int(b.get("desired_workers") or 0) for b in bodies.values()
@@ -530,9 +592,35 @@ def create_frontend_app(shard_urls: List[str]):
                 [int(b.get("desired_shards") or 0) for b in bodies.values()]
                 + [0]
             ),
+            "shard_pressure": {str(k): v for k, v in sorted(pressures.items())},
+            "hot_shard": hot_shard,
+            "imbalance_ratio": imbalance,
             "n_shards": n_shards,
             "shards_down": [k for k in range(n_shards) if k not in shards],
             "shards": bodies,
+        })
+
+    def _steal_candidates(request):
+        """Fleet steal surface: scatter /steal_candidates over every
+        shard and merge, each candidate stamped with its donor shard —
+        the discovery feed an idle shard's work-stealing loop (or an
+        operator) reads to find pullable queued work."""
+        shards = _fan_json(request, request.path)
+        merged: List[Dict[str, Any]] = []
+        pressures: Dict[str, Any] = {}
+        for k in sorted(shards):
+            body = shards[k] or {}
+            pressures[str(k)] = body.get("shard_pressure")
+            for c in body.get("candidates") or []:
+                c = dict(c)
+                c["shard"] = k
+                merged.append(c)
+        return _json({
+            "candidates": merged,
+            "n_candidates": len(merged),
+            "shard_pressure": pressures,
+            "n_shards": n_shards,
+            "shards_down": [k for k in range(n_shards) if k not in shards],
         })
 
     def _metrics_history(request):
@@ -593,14 +681,25 @@ def create_frontend_app(shard_urls: List[str]):
 
         if head in _SESSION_ROUTES and len(parts) >= 2:
             k = shard_of(parts[1], n_shards)
+            # job routes follow a migrated job's forwarding stamp: the
+            # job id is parts[2] on <sid>/<jid> routes, and in the POST
+            # body on /train_status (an SSE resume of a moved job)
+            job_id = None
+            if head in ("check_status", "download_model") and len(parts) >= 3:
+                job_id = parts[2]
+            elif head == "train_status":
+                jbody = request.get_json(force=True, silent=True) or {}
+                job_id = jbody.get("job_id") or None
             return _proxy(
-                request, k, request.path, stream=(head == "train_status")
+                request, k, request.path, stream=(head == "train_status"),
+                job_id=job_id,
             )
         if head == "metrics" and len(parts) == 3 and parts[1] not in (
             "prom", "history"
         ):
             return _proxy(
-                request, shard_of(parts[1], n_shards), request.path
+                request, shard_of(parts[1], n_shards), request.path,
+                job_id=parts[2],
             )
 
         if head in _WORKER_ROUTES and len(parts) >= 2:
@@ -639,7 +738,10 @@ def create_frontend_app(shard_urls: List[str]):
         if head in _JOB_ROUTES and len(parts) >= 2:
             k = id_shard(parts[1])
             if k is not None and k < n_shards:
-                return _proxy(request, k, request.path)
+                # cache consult only: a migrated job keeps its donor
+                # stamp, but the recorder/trace state lives wherever the
+                # job actually ran last
+                return _proxy(request, k, request.path, job_id=parts[1])
             return _scatter_first(request, request.path)
 
         if head == "dataset" and len(parts) == 2:
@@ -671,6 +773,8 @@ def create_frontend_app(shard_urls: List[str]):
             return _alerts(request)
         if head == "autoscale":
             return _autoscale(request)
+        if head == "steal_candidates":
+            return _steal_candidates(request)
         if head == "supervisor":
             return _supervisor(request)
         if head == "metrics" and len(parts) == 2 and parts[1] == "history":

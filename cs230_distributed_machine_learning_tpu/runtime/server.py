@@ -416,6 +416,13 @@ def create_app(coordinator: Optional[Coordinator] = None):
                  endpoint="slice_heartbeat", methods=["POST"]),
             Rule("/slice_status/<slice_id>", endpoint="slice_status",
                  methods=["GET"]),
+            # shard-to-shard rebalancing plane (docs/ROBUSTNESS.md "Shard
+            # rebalancing"): peers dialing peers, never client traffic
+            Rule("/migrate_in", endpoint="migrate_in", methods=["POST"]),
+            Rule("/steal_candidates", endpoint="steal_candidates",
+                 methods=["GET"]),
+            Rule("/steal_tasks", endpoint="steal_tasks", methods=["POST"]),
+            Rule("/peer_result", endpoint="peer_result", methods=["POST"]),
         ]
     )
 
@@ -586,6 +593,13 @@ def create_app(coordinator: Optional[Coordinator] = None):
                 sid, coord.canonical_job_id(body["job_id"])
             )
         )
+        if known:
+            # a resume against a job this shard ALREADY handed off must
+            # redirect, not resubmit: re-running it here would mint a
+            # second live copy of a job the recipient shard now owns
+            moved = _moved(coord.canonical_job_id(body["job_id"]))
+            if moved is not None:
+                return moved
         if not known:
             reject = _admission_reject(sid)
             if reject is not None:
@@ -623,10 +637,26 @@ def create_app(coordinator: Optional[Coordinator] = None):
 
         return Response(stream(), mimetype="text/event-stream")
 
+    def _moved(jid):
+        """Forwarding stamp for a migrated job: 409 with the destination
+        shard, or None when this shard still owns the job. Front ends
+        (runtime/frontend.py) turn the 409 into a cached redirect."""
+        dest = coord.store.migrated_to(jid)
+        if dest is None:
+            return None
+        return _json(
+            {"status": "moved", "migrated_to": dest, "job_id": jid},
+            status=409,
+        )
+
     def check_status(request, sid, jid):
         # canonicalize like the SSE-resume path: a client polling under
         # its own minted id must reach the shard-stamped job
-        return _json(coord.check_status(sid, coord.canonical_job_id(jid)))
+        jid = coord.canonical_job_id(jid)
+        moved = _moved(jid)
+        if moved is not None:
+            return moved
+        return _json(coord.check_status(sid, jid))
 
     def metrics(request, sid, jid):
         # ?wait=1: block until the job finalizes before replying — opt-in
@@ -635,6 +665,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
         # non-blocking (returns whatever has reported so far); see
         # docs/API.md "Differences from the reference".
         jid = coord.canonical_job_id(jid)
+        moved = _moved(jid)
+        if moved is not None:
+            return moved
         if request.args.get("wait"):
             timeout = float(
                 request.args.get("timeout", coord.config.service.client_timeout_s)
@@ -976,6 +1009,9 @@ def create_app(coordinator: Optional[Coordinator] = None):
         return _json({"status": "ok", "ingested": n})
 
     def download_model(request, sid, jid):
+        moved = _moved(coord.canonical_job_id(jid))
+        if moved is not None:
+            return moved
         path = coord.best_model_path(sid, coord.canonical_job_id(jid))
         if path is None:
             return _json({"status": "error", "message": "no model artifact"}, status=404)
@@ -1147,6 +1183,57 @@ def create_app(coordinator: Optional[Coordinator] = None):
             "ranks": {str(r): round(now - ts, 3) for r, ts in ranks.items()}
         })
 
+    def migrate_in(request):
+        """Peer-to-peer job handoff ingest (docs/ROBUSTNESS.md "Shard
+        rebalancing"): a hot donor shard POSTs a quiesced job's full
+        record here. The recipient journals ``migrate_in`` BEFORE the
+        donor journals its forwarding stamp, so a crash between the two
+        duplicates ownership (deduped by attempt fencing) rather than
+        losing the job. Idempotent: a duplicate export is re-accepted."""
+        body = request.get_json(force=True, silent=True) or {}
+        try:
+            return _json(coord.migrate_in(body))
+        except ValueError as e:
+            return _json({"status": "error", "message": str(e)}, status=400)
+
+    def steal_candidates(request):
+        """Queued subtasks this shard would surrender to an idle peer
+        (work stealing). Empty unless rebalancing is enabled AND the
+        local shard_pressure is over the hot threshold — a busy-but-
+        coping shard keeps its queue."""
+        return _json(coord.steal_candidates())
+
+    def steal_tasks(request):
+        """Grant endpoint for work stealing: the thief POSTs
+        ``{"thief_shard": k, "max_n": n}`` and receives fenced task
+        attempts (fresh attempt number, donor-side tombstone journaled)
+        it may run locally. Results flow back via /peer_result."""
+        body = request.get_json(force=True, silent=True) or {}
+        try:
+            thief = int(body.get("thief_shard", -1))
+            max_n = int(body.get("max_n", coord.config.service.steal_max_tasks))
+        except (TypeError, ValueError):
+            from werkzeug.exceptions import BadRequest
+
+            raise BadRequest("thief_shard and max_n must be integers")
+        return _json({"tasks": coord.release_for_steal(thief, max_n)})
+
+    def peer_result(request):
+        """Result relay from a peer shard: forwarded late results from a
+        migration donor, or stolen-task results from a thief. Each result
+        is published onto the local bus exactly as a worker result would
+        be — the ingest loop's dedup/staleness rules apply unchanged."""
+        body = request.get_json(force=True, silent=True) or {}
+        results = body.get("results")
+        if results is None:
+            results = [body]
+        n = 0
+        for r in results:
+            if isinstance(r, dict) and r.get("subtask_id"):
+                coord.ingest_peer_result(r)
+                n += 1
+        return _json({"status": "ok", "ingested": n})
+
     handlers = locals()
 
     # CORS parity with the reference master's flask-cors default config
@@ -1265,6 +1352,13 @@ def main() -> None:
                         help="serve shard K of a sharded control plane")
     parser.add_argument("--num-shards", type=int, default=1, metavar="N",
                         help="total shards in the fleet (with --shard-index)")
+    # rebalancing peer directory: base URLs of EVERY shard (index == list
+    # position, including this one — it is skipped when dialing). Static
+    # because ShardFleet allocates ports before any shard starts; action
+    # is still gated on service.rebalance_enabled.
+    parser.add_argument("--peers", default=None, metavar="URL,URL,...",
+                        help="comma-separated shard base URLs for "
+                             "cross-shard migration / work stealing")
     args = parser.parse_args()
     if args.direct and args.agent_executors > 0:
         parser.error("--agent-executors requires cluster mode (drop --direct)")
@@ -1327,6 +1421,11 @@ def main() -> None:
         coord = Coordinator(
             cluster=cluster, journal=args.journal, **shard_kwargs
         )
+        if args.peers:
+            coord.peer_urls = [
+                u.strip().rstrip("/")
+                for u in args.peers.split(",") if u.strip()
+            ]
         if args.agent_executors > 0:
             from ..utils.config import get_config as _cfg
             from .supervisor import AgentSupervisor, agent_command

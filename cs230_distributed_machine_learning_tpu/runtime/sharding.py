@@ -37,6 +37,8 @@ from __future__ import annotations
 
 import hashlib
 import re
+import threading
+import time
 from typing import Optional
 
 #: stamp grammar shared by job and worker ids: ``s<2-digit shard>-<rest>``
@@ -128,3 +130,55 @@ def shard_service_config(cfg, n_shards: int):
     if not updates:
         return cfg
     return cfg.merged({"service": updates})
+
+
+class ForwardingCache:
+    """Bounded-TTL job→shard redirect cache for migrated jobs.
+
+    When a job migrates (docs/ROBUSTNESS.md "Shard rebalancing") the
+    donor shard answers its job routes with ``409 {"status": "moved",
+    "migrated_to": k}`` — the forwarding stamp. Without a cache every
+    request for a migrated job pays a probe-then-redirect round trip;
+    with it the front end proxies straight to the new owner until the
+    entry expires. TTL-bounded (not permanent) because a stamp can go
+    stale — the job may migrate again, or the fleet may be redeployed
+    with a different shard count — and a bounded re-probe beats serving
+    a wrong shard forever. Entry count is bounded so a scan over many
+    dead job ids cannot grow front-end memory without limit."""
+
+    def __init__(self, ttl_s: float = 300.0, max_entries: int = 4096):
+        self.ttl_s = float(ttl_s)
+        self.max_entries = int(max_entries)
+        self._lock = threading.Lock()
+        self._entries: dict = {}  # job_id -> (shard, expires_at)
+
+    def get(self, job_id: str) -> Optional[int]:
+        """Cached destination shard for a job id, or None (unknown or
+        expired — expired entries are dropped on read)."""
+        with self._lock:
+            hit = self._entries.get(job_id)
+            if hit is None:
+                return None
+            shard, expires = hit
+            if time.time() >= expires:
+                self._entries.pop(job_id, None)
+                return None
+            return shard
+
+    def put(self, job_id: str, shard: int) -> None:
+        with self._lock:
+            if job_id not in self._entries and len(self._entries) >= self.max_entries:
+                now = time.time()
+                expired = [j for j, (_, exp) in self._entries.items() if now >= exp]
+                for j in expired:
+                    self._entries.pop(j, None)
+                if len(self._entries) >= self.max_entries:
+                    # still full: evict the soonest-to-expire entry —
+                    # O(n), but only on the overflow path
+                    oldest = min(self._entries, key=lambda j: self._entries[j][1])
+                    self._entries.pop(oldest, None)
+            self._entries[job_id] = (int(shard), time.time() + self.ttl_s)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -65,6 +65,21 @@ class JobStore:
         #: coordinator's placement engine resumes its generation counter
         #: monotonically instead of restarting at 0
         self.mesh_generation = 0
+        #: forwarding stamps (docs/ROBUSTNESS.md "Shard rebalancing"):
+        #: job_id -> destination shard for jobs this store migrated OUT.
+        #: The donor keeps the record but stops resuming/serving it —
+        #: job routes answer 409 moved so front ends redirect.
+        self._migrated: Dict[str, int] = {}
+        #: job ids adopted from a donor shard (migrate_in). These keep
+        #: the DONOR's stamp, so canonical_job_id must pass them through
+        #: instead of re-wrapping into an id this shard never stored.
+        self._adopted: set = set()
+        #: donor-side steal tombstones: subtask_id -> grant info for
+        #: queued subtasks handed to a thief shard. While a tombstone is
+        #: live the donor never re-dispatches the subtask; the entry is
+        #: cleared by the subtask's next result (any status) or reclaimed
+        #: after ``steal_lease_s`` if the thief went dark.
+        self.steal_tombstones: Dict[str, Dict[str, Any]] = {}
         if journal_dir:
             os.makedirs(journal_dir, exist_ok=True)
             self._journal_path = os.path.join(journal_dir, "jobs.jsonl")
@@ -133,6 +148,10 @@ class JobStore:
                             "pruned_subtasks": job.get("pruned_subtasks", 0),
                             "created_at": job.get("created_at"),
                             "completion_time": job.get("completion_time"),
+                            # rebalancing provenance: where the job went
+                            # (donor view) / came from (recipient view)
+                            "migrated_to": job.get("migrated_to"),
+                            "migrated_from": job.get("migrated_from"),
                         }
                     )
         out.sort(key=lambda j: j.get("created_at") or 0, reverse=True)
@@ -207,6 +226,9 @@ class JobStore:
             job = self._require_job(sid, job_id)
             sub = job["subtasks"][subtask_id]
             self._apply_subtask_update(job, sub, status, json_safe(result))
+            # any delivered result retires a steal tombstone: the grant
+            # is settled (terminal) or back in the donor's retry path
+            self.steal_tombstones.pop(subtask_id, None)
         self._journal(
             {
                 "op": "update_subtask",
@@ -351,6 +373,128 @@ class JobStore:
             sess = self._sessions.get(sid)
             return bool(sess and job_id in sess["jobs"])
 
+    # ---------------- cross-shard rebalancing ----------------
+    # (docs/ROBUSTNESS.md "Shard rebalancing") — the journal is the
+    # migration transport: ``migrate_in`` lands the full job record on
+    # the recipient BEFORE the donor stamps ``migrate_out``, so a crash
+    # between the two leaves at most a duplicated (deduped) owner, never
+    # a lost job.
+
+    def migrated_to(self, job_id: str) -> Optional[int]:
+        """Destination shard for a job this store migrated away, or
+        None for jobs still owned here (the forwarding stamp)."""
+        return self._migrated.get(job_id)
+
+    def record_migrate_out(self, sid: str, job_id: str, dest_shard: int) -> None:
+        """Stamp a job as migrated to ``dest_shard``. The record stays
+        (job routes need it to answer 409 moved) but the job leaves
+        ``unfinished_jobs``/``unfinished_counts`` — a restarted donor
+        must not resume a job it gave away."""
+        with self._lock:
+            job = self._require_job(sid, job_id)
+            job["migrated_to"] = int(dest_shard)
+            self._migrated[job_id] = int(dest_shard)
+            # a waiter blocked in wait_job must not hang on a job that
+            # left this shard; it re-reads status and sees the move
+            event = self._done_events.pop((sid, job_id), None)
+        self._journal(
+            {"op": "migrate_out", "sid": sid, "jid": job_id,
+             "dest": int(dest_shard)}
+        )
+        if event is not None:
+            event.set()
+
+    def import_job(
+        self,
+        sid: str,
+        record: Dict[str, Any],
+        source_shard: Optional[int] = None,
+    ) -> None:
+        """Install a full job record exported by a donor shard. The
+        journal entry carries the whole record (like ``create_job``) so
+        a recipient crash after the import replays into the identical
+        adopted state."""
+        record = json_safe(record)
+        record["migrated_from"] = source_shard
+        # a record can never arrive still wearing the donor's own
+        # forwarding stamp, but strip defensively: this shard OWNS it now
+        record.pop("migrated_to", None)
+        with self._lock:
+            self._require_session(sid)["jobs"][record["job_id"]] = record
+            self._adopted.add(record["job_id"])
+        self._journal(
+            {"op": "migrate_in", "sid": sid, "record": record,
+             "source_shard": source_shard}
+        )
+
+    def is_adopted_job(self, job_id: str) -> bool:
+        """True for ids this store adopted via ``import_job`` — they wear
+        the DONOR's shard stamp and must not be re-canonicalized."""
+        return job_id in self._adopted
+
+    def record_steal(
+        self,
+        sid: str,
+        job_id: str,
+        subtask_id: str,
+        thief_shard: int,
+        attempt: int,
+    ) -> None:
+        """Tombstone a queued subtask granted to a thief shard. The
+        journaled attempt is the FENCED attempt the thief executes —
+        replay restores the tombstone (with a fresh lease clock, the
+        conservative side) so a restarted donor still won't double-run
+        the subtask inside the lease window."""
+        with self._lock:
+            self.steal_tombstones[subtask_id] = {
+                "sid": sid, "jid": job_id, "thief": int(thief_shard),
+                "attempt": int(attempt), "ts": time.time(),
+            }
+        self._journal(
+            {"op": "steal", "sid": sid, "jid": job_id, "stid": subtask_id,
+             "thief": int(thief_shard), "attempt": int(attempt)}
+        )
+
+    def clear_steal(self, subtask_id: str) -> None:
+        """Drop a steal tombstone (result arrived, or lease reclaimed).
+        Not journaled: the matching ``update_subtask``/``subtask_attempt``
+        entry already encodes the outcome, and a replayed tombstone for a
+        terminal subtask is cleared by the update's replay."""
+        if not self.steal_tombstones:
+            return
+        with self._lock:
+            self.steal_tombstones.pop(subtask_id, None)
+
+    def lookup_specs(self, subtask_ids) -> Dict[str, Dict[str, Any]]:
+        """Resolve live (non-terminal, non-migrated) subtask ids to
+        ``{session_id, job_id, spec, metadata}`` copies in one lock pass
+        — the steal-grant path's bridge from the placement engine's
+        id-only queue snapshot back to dispatchable task dicts."""
+        wanted = set(subtask_ids)
+        out: Dict[str, Dict[str, Any]] = {}
+        if not wanted:
+            return out
+        with self._lock:
+            for sid, sess in self._sessions.items():
+                for jid, job in sess["jobs"].items():
+                    if job.get("migrated_to") is not None:
+                        continue
+                    if job["status"] in TERMINAL_STATUSES:
+                        continue
+                    for stid in wanted & set(job["subtasks"]):
+                        sub = job["subtasks"][stid]
+                        if sub["status"] in SUBTASK_TERMINAL_STATUSES:
+                            continue
+                        out[stid] = {
+                            "session_id": sid,
+                            "job_id": jid,
+                            "spec": json.loads(json.dumps(sub["spec"])),
+                            "metadata": json.loads(
+                                json.dumps(job.get("metadata") or {})
+                            ),
+                        }
+        return out
+
     def unfinished_counts(self) -> Dict[str, Any]:
         """Admission-control inputs in one lock hold: unfinished job count
         (global + per session) and the total PENDING subtasks across those
@@ -363,6 +507,11 @@ class JobStore:
             for sid, sess in self._sessions.items():
                 for job in sess["jobs"].values():
                     if job["status"] in TERMINAL_STATUSES:
+                        continue
+                    # migrated-away jobs are the destination shard's
+                    # load now — counting them here would double-charge
+                    # the fleet's admission caps
+                    if job.get("migrated_to") is not None:
                         continue
                     jobs += 1
                     per_session[sid] = per_session.get(sid, 0) + 1
@@ -410,6 +559,10 @@ class JobStore:
         with self._lock:
             job = self._require_job(sid, job_id)
             if job["status"] in TERMINAL_STATUSES:
+                return True
+            if job.get("migrated_to") is not None:
+                # the job will never finalize HERE: the waiter re-reads
+                # status and follows the forwarding stamp
                 return True
             event = self._done_events.setdefault((sid, job_id), threading.Event())
         return event.wait(timeout)
@@ -460,13 +613,17 @@ class JobStore:
 
     def unfinished_jobs(self) -> List[tuple]:
         """(sid, job_id) of jobs not yet finalized — after a journal replay
-        these are the in-flight jobs a restarted coordinator must resume."""
+        these are the in-flight jobs a restarted coordinator must resume.
+        Jobs wearing a ``migrated_to`` forwarding stamp are excluded —
+        the destination shard owns them, and a restarted donor resuming
+        one would race the owner with duplicate attempts."""
         with self._lock:
             return [
                 (sid, jid)
                 for sid, sess in self._sessions.items()
                 for jid, job in sess["jobs"].items()
                 if job["status"] not in TERMINAL_STATUSES
+                and job.get("migrated_to") is None
             ]
 
     def subtask_results(self, sid: str, job_id: str) -> List[Dict[str, Any]]:
@@ -564,6 +721,9 @@ class JobStore:
                 self._apply_subtask_update(
                     job, sub, e["status"], e.get("result")
                 )
+                # mirror the live path: a replayed result retires any
+                # earlier-journaled steal tombstone for the subtask
+                self.steal_tombstones.pop(e["stid"], None)
             elif op == "subtask_attempt":
                 # fault-tolerance bookkeeping (docs/ROBUSTNESS.md):
                 # restore retry budgets / excluded-worker memory into
@@ -590,6 +750,32 @@ class JobStore:
                 self.mesh_generation = max(
                     self.mesh_generation, int(e.get("generation", 0) or 0)
                 )
+            elif op == "migrate_out":
+                # forwarding stamp: the job left this shard. Restore the
+                # stamp AND the lookup index so a restarted donor serves
+                # 409 moved instead of resuming a job it gave away.
+                job = self._sessions[e["sid"]]["jobs"][e["jid"]]
+                job["migrated_to"] = int(e.get("dest", 0) or 0)
+                self._migrated[e["jid"]] = int(e.get("dest", 0) or 0)
+            elif op == "migrate_in":
+                # adopted job: the entry carries the donor's full record
+                # (same shape as create_job), so replay reinstalls the
+                # identical state resume_inflight adopts from
+                self._sessions.setdefault(
+                    e["sid"], {"created_at": time.time(), "jobs": {},
+                               "priority": 0}
+                )["jobs"][e["record"]["job_id"]] = e["record"]
+                self._adopted.add(e["record"]["job_id"])
+            elif op == "steal":
+                # restore the donor-side tombstone with a FRESH lease
+                # clock (conservative: the thief gets a full lease after
+                # a donor restart before the subtask is reclaimed)
+                self.steal_tombstones[e["stid"]] = {
+                    "sid": e["sid"], "jid": e["jid"],
+                    "thief": int(e.get("thief", 0) or 0),
+                    "attempt": int(e.get("attempt", 0) or 0),
+                    "ts": time.time(),
+                }
             elif op == "finalize_job":
                 job = self._sessions[e["sid"]]["jobs"][e["jid"]]
                 job["result"] = e["result"]

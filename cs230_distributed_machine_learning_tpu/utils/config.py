@@ -184,6 +184,33 @@ class ServiceConfig:
     route_p99_slo_s: float = 2.0
     sse_lag_slo_s: float = 5.0
     alert_admission_reject_per_s: float = 0.2
+    # ---- cross-shard rebalancing (docs/ROBUSTNESS.md "Shard
+    # rebalancing"): job migration + work stealing, driven by the
+    # per-shard pressure signal (obs/signals.py tpuml_shard_pressure) ----
+    # master valve: even with peers wired (server --peers) a shard takes
+    # no rebalancing ACTION unless enabled (the peer endpoints still
+    # answer, so a mixed fleet degrades to one-sided stealing)
+    rebalance_enabled: bool = False
+    # floor between rebalance passes (each pass does peer HTTP probes,
+    # so it must not run at sweep/scrape cadence)
+    rebalance_interval_s: float = 10.0
+    # a shard at/above this tpuml_shard_pressure is HOT: it offers steal
+    # candidates and looks for a cold peer to migrate a job to
+    rebalance_hot_pressure: float = 2.0
+    # a peer at/below this pressure is drainable-COLD: eligible migration
+    # destination; a shard at/below it with idle workers turns thief
+    rebalance_cold_pressure: float = 0.5
+    # hot/cold pressure ratio floor: migration only fires when the skew
+    # is real (keeps balanced fleets from ping-ponging jobs)
+    rebalance_imbalance_ratio: float = 3.0
+    # how long the donor keeps replaying-forward late results for a
+    # migrated job (at-least-once across the handoff)
+    rebalance_forward_s: float = 120.0
+    # max queued subtasks one steal grant hands a thief shard
+    steal_max_tasks: int = 8
+    # donor-side steal lease: a tombstone older than this with no result
+    # from the thief is reclaimed (fresh attempt fences the thief)
+    steal_lease_s: float = 120.0
 
 
 @dataclasses.dataclass

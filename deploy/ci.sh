@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs|trace]
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs|trace|rebalance]
 #   (default: fast)
 #
 #   fast mode:
@@ -93,6 +93,20 @@
 #   ≈ store wall and ≥80 % of the delta attributed), refreshing
 #   CRITICAL_PATH.json into bench-artifacts/ and re-validating the
 #   Perfetto export the drill wrote as loadable Chrome trace JSON.
+#
+#   rebalance mode (every push in ci.yml, fast): the cross-shard
+#   rebalancing gate (docs/ROBUSTNESS.md "Shard rebalancing") — the
+#   fencing/tombstone/forwarding unit suite (tests/test_rebalance.py:
+#   migrate_out/migrate_in/steal journal round-trips + crash-point
+#   truncation fuzz, steal-grant fencing and lease reclaim, the 409
+#   forwarding stamp and the front end's bounded-TTL redirect cache,
+#   live HTTP migration between two coordinators). With
+#   REBALANCE_FULL=1 (nightly/dispatch) it additionally runs the full
+#   skewed-hash load test (benchmarks/loadtest_skew.py --check: 80/20
+#   session skew must recover >= 0.8x the even-hash jobs/s with the
+#   rebalancer demonstrably acting) and uploads the fresh
+#   LOADTEST_SKEW.json (the committed acceptance artifact is
+#   benchmarks/LOADTEST_SKEW.json).
 #
 #   chaos mode (manually-triggered + nightly in ci.yml): the slow-marked
 #   chaos/durability suites — fleet kill-mid-job, hung-worker lease
@@ -344,6 +358,33 @@ PYEOF
   then
     echo "Perfetto validity gate FAILED"
     rc=1
+  fi
+elif [ "$MODE" = "rebalance" ]; then
+  echo "== cross-shard rebalancing suite (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_rebalance.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  if [ "${REBALANCE_FULL:-0}" = "1" ]; then
+    # nightly/dispatch: the full skewed-hash load test — even baseline,
+    # skew with rebalancing off, skew with rebalancing on — gated on
+    # recovery >= 0.8 and the rebalancer actually acting; the fresh
+    # JSON is uploaded for trend-watching (the committed acceptance
+    # artifact is benchmarks/LOADTEST_SKEW.json)
+    echo "== FULL skewed-hash rebalance load test (recovery gate) =="
+    mkdir -p bench-artifacts
+    if SKEW_OUT=bench-artifacts/LOADTEST_SKEW.json \
+        JAX_PLATFORMS=cpu python benchmarks/loadtest_skew.py --check \
+        > bench-artifacts/loadtest_skew.log 2>&1; then
+      tail -n 2 bench-artifacts/loadtest_skew.log
+    else
+      echo "loadtest_skew FAILED (see bench-artifacts/loadtest_skew.log)"
+      tail -n 20 bench-artifacts/loadtest_skew.log
+      rc=1
+    fi
   fi
 elif [ "$MODE" = "loadtest" ]; then
   # full sharded control-plane load test (nightly/dispatch in ci.yml):

@@ -672,3 +672,266 @@ def test_chaos_shard_sigkill_takeover_with_parity(tmp_path):
         assert "tpuml_recovery_jobs_resumed_total 1" in prom
     finally:
         fleet.stop()
+
+# =====================================================================
+# Skewed-hash rebalancing drills (ISSUE 19 acceptance): a static session
+# hash pins 80% of the load to one shard. The rebalancing plane —
+# cross-shard job migration + work stealing, driven by
+# tpuml_shard_pressure — must (a) recover >= 80% of the even-hash
+# fleet's jobs/s under that skew, and (b) survive a SIGKILL of EITHER
+# migration party mid-handoff with zero lost and zero duplicated trials
+# (score parity vs an uninterrupted identical job). The kill is aimed at
+# the riskiest window — after the recipient journals ``migrate_in`` but
+# before the donor journals ``migrate_out`` — held open by the
+# CS230_MIGRATE_DELAY_S chaos hook (docs/ROBUSTNESS.md "Shard
+# rebalancing").
+# =====================================================================
+
+N_REBAL_TRIALS = 40
+
+
+def _rebal_env() -> dict:
+    """2-shard drill knobs: a small per-shard admission carve (4 jobs)
+    and a short autoscale refresh so the skewed burst reads as
+    shard_pressure >= 1 on the hot shard while the drained peer reads
+    ~0 (cold); the migrate-delay hook holds the stamp window open for a
+    deterministic kill."""
+    return {
+        "CS230_PREWARM": "0",
+        "TPUML_SCHEDULER__HEARTBEAT_INTERVAL_S": "0.5",
+        "TPUML_SCHEDULER__SWEEP_INTERVAL_S": "1.0",
+        "TPUML_SCHEDULER__DEAD_AFTER_S": "15",
+        "TPUML_SCHEDULER__LEASE_FLOOR_S": "1800",
+        "TPUML_SCHEDULER__SPECULATIVE_ENABLED": "false",
+        "TPUML_EXECUTION__MAX_TRIALS_PER_BATCH": "4",
+        "TPUML_SERVICE__MAX_INFLIGHT_JOBS": "8",
+        "TPUML_SERVICE__AUTOSCALE_INTERVAL_S": "0.5",
+        "TPUML_SERVICE__AUTOSCALE_HORIZON_S": "60",
+        "TPUML_SERVICE__REBALANCE_ENABLED": "1",
+        "TPUML_SERVICE__REBALANCE_INTERVAL_S": "1.0",
+        "TPUML_SERVICE__REBALANCE_HOT_PRESSURE": "1.0",
+        "TPUML_SERVICE__REBALANCE_COLD_PRESSURE": "0.3",
+        "TPUML_SERVICE__REBALANCE_IMBALANCE_RATIO": "1.5",
+        "TPUML_SERVICE__STEAL_MAX_TASKS": "4",
+        "TPUML_SERVICE__STEAL_LEASE_S": "30",
+        "CS230_MIGRATE_DELAY_S": "6.0",
+    }
+
+
+def _rebal_payload():
+    from sklearn.model_selection import GridSearchCV
+
+    from cs230_distributed_machine_learning_tpu.client.introspection import (
+        extract_model_details,
+    )
+
+    grid = GridSearchCV(
+        LogisticRegression(max_iter=200),
+        {
+            "C": list(np.logspace(-3, 2, N_REBAL_TRIALS // 2)),
+            "fit_intercept": [True, False],
+        },
+        cv=3,
+    )
+    return {
+        "dataset_id": "iris",
+        "model_details": extract_model_details(grid),
+        "train_params": {"random_state": 0},
+    }
+
+
+def _prom_counter(url: str, name: str, label_frag: str = "") -> float:
+    """Sum of a counter's cells matching a label fragment on one
+    /metrics/prom exposition; 0.0 when unreachable."""
+    import requests
+
+    total = 0.0
+    try:
+        text = requests.get(f"{url}/metrics/prom", timeout=5).text
+    except Exception:  # noqa: BLE001 — outage window scrapes as zero
+        return total
+    for line in text.splitlines():
+        if line.startswith(name) and (not label_frag or label_frag in line):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                continue
+    return total
+
+
+def _run_rebalance_kill_drill(base: str, kill_party: str) -> None:
+    """Shared body of the donor-kill and recipient-kill drills: an 80/20
+    skewed 2-shard fleet with rebalancing on, SIGKILL of one migration
+    party inside the migrate_in->migrate_out stamp window, restart on
+    the same journal dir, then full-fleet completion with score parity
+    vs an uninterrupted reference job. Either interleaving of the kill
+    vs the handoff is legal — duplicated OWNERSHIP is allowed (both
+    shards may run the job), duplicated or lost TRIALS are not: the
+    client-visible record must hold every trial exactly once."""
+    import requests
+
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.fleet import ShardFleet
+    from cs230_distributed_machine_learning_tpu.utils.config import (
+        FrameworkConfig, set_config,
+    )
+
+    root = os.path.join(base, "fleet")
+    cfg = FrameworkConfig.load(env={})
+    cfg.storage.root = root
+    set_config(cfg)
+    materialize_builtin("iris")
+
+    fleet = ShardFleet(
+        2,
+        storage_root=root,
+        n_frontends=1,
+        local_executors=1,
+        journal=True,
+        log_dir=base,
+        env=_rebal_env(),
+    )
+    payload = _rebal_payload()
+    try:
+        fleet.start()
+        fe = fleet.frontend_urls[0]
+
+        # 80/20 skew: 4 sessions hashed to shard 0, 1 to shard 1
+        sessions = {0: [], 1: []}
+        want = {0: 4, 1: 1}
+        for _ in range(128):
+            if all(len(sessions[k]) >= want[k] for k in want):
+                break
+            body = requests.post(f"{fe}/create_session", timeout=30).json()
+            k = body.get("shard")
+            if k in sessions and len(sessions[k]) < want[k]:
+                sessions[k].append(body["session_id"])
+        assert all(len(sessions[k]) >= want[k] for k in want)
+
+        # parity reference: the identical job, uninterrupted, run FIRST
+        # on the cold shard (which is then drained — and reads cold —
+        # when the skewed burst lands)
+        sid_ref = sessions[1][0]
+        r = requests.post(f"{fe}/train/{sid_ref}", json=payload, timeout=60)
+        r.raise_for_status()
+        ref = _wait_terminal(fe, sid_ref, r.json()["job_id"], 900)
+        assert ref["job_status"] == "completed"
+        ref_scores = {
+            _trial_no(x): x["mean_cv_score"]
+            for x in ref["job_result"]["results"]
+        }
+
+        # the skewed burst: 4 identical jobs pinned to shard 0 — its
+        # admission carve (4) saturates, shard_pressure >= hot
+        jobs = []
+        for sid in sessions[0]:
+            r = requests.post(f"{fe}/train/{sid}", json=payload, timeout=60)
+            r.raise_for_status()
+            jobs.append((sid, r.json()["job_id"]))
+
+        # the recipient journals migrate_in FIRST; once its counter
+        # ticks, the donor is inside the CS230_MIGRATE_DELAY_S window
+        # with migrate_out still unjournaled — the riskiest instant
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if _prom_counter(
+                fleet.shard_urls[1],
+                "tpuml_jobs_migrated_total", 'direction="in"',
+            ) >= 1:
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("no migration was ever accepted")
+
+        victim = 0 if kill_party == "donor" else 1
+        fleet.kill_shard(victim, signal.SIGKILL)
+        time.sleep(1.0)
+        fleet.restart_shard(victim)
+
+        # the whole fleet settles: every skewed job reaches a terminal
+        # status with no lost and no duplicated trials, wherever it ran
+        for sid, jid in jobs:
+            final = _wait_terminal(fe, sid, jid, 900)
+            assert final["job_status"] == "completed", (jid, final)
+            results = final["job_result"]["results"]
+            assert len(results) == N_REBAL_TRIALS, jid
+            ids = [x["subtask_id"] for x in results]
+            assert len(set(ids)) == N_REBAL_TRIALS, (
+                f"duplicated trials in {jid}"
+            )
+            assert final["job_result"]["failed"] == []
+            # score parity vs the uninterrupted reference (requeued /
+            # migrated trials re-run under a different chunk geometry:
+            # same tolerance as the coordinator-kill drill)
+            for x in results:
+                assert x["mean_cv_score"] == pytest.approx(
+                    ref_scores[_trial_no(x)], abs=3e-3
+                ), (jid, x["subtask_id"])
+            best = final["job_result"]["best_result"]
+            ref_best = ref["job_result"]["best_result"]
+            assert best["parameters"]["C"] == ref_best["parameters"]["C"]
+    finally:
+        fleet.stop()
+
+
+@pytest.mark.slow  # 2-shard fleet, a kill + journal restart: minutes
+def test_chaos_rebalance_donor_sigkill_mid_migration_parity(tmp_path):
+    """DONOR killed inside the stamp window: the recipient has journaled
+    migrate_in but the donor never journals migrate_out, so the restarted
+    donor still owns the job (duplicate ownership, deduped at the
+    client's routing) — nothing is lost."""
+    art = os.environ.get("CI_ARTIFACTS_DIR")
+    base = os.path.join(art, "rebalance_donor_kill") if art else str(tmp_path)
+    os.makedirs(base, exist_ok=True)
+    _run_rebalance_kill_drill(base, "donor")
+
+
+@pytest.mark.slow  # 2-shard fleet, a kill + journal restart: minutes
+def test_chaos_rebalance_recipient_sigkill_mid_migration_parity(tmp_path):
+    """RECIPIENT killed inside the stamp window: its journaled
+    migrate_in replays on restart and the adopted job resumes there,
+    while the donor either stamped migrate_out (front ends follow the
+    409 forwarding stamp) or aborted and respawned the job locally —
+    both interleavings keep every trial exactly once."""
+    art = os.environ.get("CI_ARTIFACTS_DIR")
+    base = (
+        os.path.join(art, "rebalance_recipient_kill") if art else str(tmp_path)
+    )
+    os.makedirs(base, exist_ok=True)
+    _run_rebalance_kill_drill(base, "recipient")
+
+
+@pytest.mark.slow  # three fleet boots + three measured windows: minutes
+def test_chaos_skewed_hash_rebalance_recovers_throughput(tmp_path):
+    """The throughput half of the ISSUE 19 acceptance: 80% of sessions
+    hashed to one shard must not halve the fleet. Reuses the committed
+    benchmark harness (benchmarks/loadtest_skew.py) at its artifact
+    sizing: even-hash baseline, skewed with rebalancing off, skewed with
+    rebalancing on — the recovered jobs/s must be >= 0.8x the even-hash
+    baseline, and the rebalancer must have actually acted."""
+    import importlib.util
+
+    from cs230_distributed_machine_learning_tpu.utils.config import (
+        FrameworkConfig, set_config,
+    )
+
+    cfg = FrameworkConfig.load(env={})
+    cfg.storage.root = os.path.join(str(tmp_path), "fleet")
+    set_config(cfg)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "loadtest_skew", os.path.join(repo_root, "benchmarks", "loadtest_skew.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    out = mod.run(clients=10, jobs_per_client=2)
+    for name, ph in out["phases"].items():
+        assert ph["jobs"]["completed"] == ph["jobs"]["target"], (name, ph["jobs"])
+        assert ph["errors"] == [], (name, ph["errors"])
+    rec = out["recovery"]
+    assert rec["jobs_migrated"] + rec["subtasks_stolen"] >= 1, rec
+    assert rec["fraction"] is not None and rec["fraction"] >= 0.8, rec

@@ -1,0 +1,559 @@
+"""Self-balancing fleet: cross-shard migration + work stealing (fast tier).
+
+The crash-safety and routing contracts of docs/ROBUSTNESS.md "Shard
+rebalancing", pinned WITHOUT subprocess fleets: journal round-trips for
+the three new ops (``migrate_out`` / ``migrate_in`` / ``steal``),
+crash-point truncation fuzz over a rebalance-heavy journal, steal
+tombstone dedup + lease reclaim, the donor's 409 forwarding stamp, the
+front end's bounded-TTL redirect cache, and a full quiesce → fence →
+export → adopt migration between two live in-process coordinators over
+real HTTP. The skewed-fleet SIGKILL drills live in tests/test_chaos.py
+(slow tier)."""
+
+import os
+import threading
+import time
+import uuid
+
+import requests
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu.client.introspection import (
+    extract_model_details,
+)
+from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+from cs230_distributed_machine_learning_tpu.runtime.cluster import ClusterRuntime
+from cs230_distributed_machine_learning_tpu.runtime.coordinator import Coordinator
+from cs230_distributed_machine_learning_tpu.runtime.server import create_app
+from cs230_distributed_machine_learning_tpu.runtime.sharding import (
+    ForwardingCache,
+    shard_of,
+)
+from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+from cs230_distributed_machine_learning_tpu.utils.config import get_config
+
+
+def _counter(name, **labels) -> float:
+    c = REGISTRY.get(name)
+    return c.value(**labels) if c is not None else 0.0
+
+
+def _serve(coord):
+    from werkzeug.serving import make_server
+
+    server = make_server("127.0.0.1", 0, create_app(coord), threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, f"http://127.0.0.1:{server.server_port}"
+
+
+# ---------------------------------------------------------------------
+# journal round-trips for the new ops
+# ---------------------------------------------------------------------
+
+
+def test_migrate_out_journal_round_trip(tmp_path):
+    """The forwarding stamp survives replay: a restarted donor still
+    answers "moved", never resumes the handed-off job."""
+    jd = str(tmp_path / "donor")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(
+        sid, "m", {}, [{"subtask_id": f"m-s{i}"} for i in range(2)]
+    )
+    assert store.migrated_to("m") is None
+    store.record_migrate_out(sid, "m", 1)
+    assert store.migrated_to("m") == 1
+    # a migrated-away job is no longer this shard's unfinished work
+    assert ("m" not in [j for _, j in store.unfinished_jobs()])
+    assert store.unfinished_counts()["jobs"] == 0
+
+    resumed = JobStore(journal_dir=jd)
+    assert resumed.replay_skipped == 0
+    assert resumed.migrated_to("m") == 1
+    assert resumed.unfinished_jobs() == []
+    # waiters unblock: the job will never finalize HERE
+    assert resumed.wait_job(sid, "m", timeout=0.1) is True
+
+
+def test_migrate_in_journal_round_trip(tmp_path):
+    """The recipient's adopted record replays whole — subtask state,
+    results, and the migrated_from attribution."""
+    donor = JobStore(journal_dir=str(tmp_path / "donor"))
+    sid = donor.create_session(priority=3)
+    donor.create_job(
+        sid, "m", {"dataset_id": "iris"},
+        [{"subtask_id": f"m-s{i}"} for i in range(3)],
+    )
+    donor.update_subtask(
+        sid, "m", "m-s0", "completed", {"mean_cv_score": 0.9}
+    )
+    record = donor.get_job(sid, "m")
+
+    jd = str(tmp_path / "recipient")
+    rec = JobStore(journal_dir=jd)
+    rec.create_session(sid, priority=3)
+    rec.import_job(sid, record, source_shard=0)
+    assert rec.has_job(sid, "m")
+
+    resumed = JobStore(journal_dir=jd)
+    assert resumed.replay_skipped == 0
+    job = resumed.get_job(sid, "m")
+    assert job["migrated_from"] == 0
+    assert job["subtasks"]["m-s0"]["status"] == "completed"
+    prog = resumed.job_progress(sid, "m")
+    assert prog["tasks_completed"] == 1 and prog["tasks_pending"] == 2
+    # adopted work IS this shard's unfinished work now
+    assert (sid, "m") in resumed.unfinished_jobs()
+    # the adopted-id marker survives replay, so canonical_job_id keeps
+    # passing the donor-stamped id through after a recipient restart
+    assert rec.is_adopted_job("m") and resumed.is_adopted_job("m")
+
+
+def test_steal_tombstone_journal_and_result_clears_it(tmp_path):
+    jd = str(tmp_path / "j")
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(
+        sid, "t", {}, [{"subtask_id": "t-s0"}, {"subtask_id": "t-s1"}]
+    )
+    store.record_steal(sid, "t", "t-s0", thief_shard=1, attempt=2)
+    assert "t-s0" in store.steal_tombstones
+    assert store.steal_tombstones["t-s0"]["thief"] == 1
+
+    # replay restores the tombstone (a restarted donor must not
+    # re-dispatch a granted subtask inside the lease)
+    resumed = JobStore(journal_dir=jd)
+    assert resumed.replay_skipped == 0
+    assert "t-s0" in resumed.steal_tombstones
+
+    # ANY terminal result settles the grant — live and replayed alike
+    store.update_subtask(
+        sid, "t", "t-s0", "completed", {"mean_cv_score": 0.8, "attempt": 2}
+    )
+    assert "t-s0" not in store.steal_tombstones
+    replayed = JobStore(journal_dir=jd)
+    assert replayed.replay_skipped == 0
+    assert "t-s0" not in replayed.steal_tombstones
+
+
+def _rebalance_journal(jd: str) -> str:
+    """A journal exercising every rebalance op interleaved with normal
+    job traffic: steal granted + settled, steal outstanding, and the
+    migrate_out stamp."""
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(
+        sid, "rb", {"dataset_id": "iris"},
+        [{"subtask_id": f"rb-s{i}"} for i in range(3)],
+    )
+    store.record_steal(sid, "rb", "rb-s0", thief_shard=1, attempt=1)
+    store.update_subtask(
+        sid, "rb", "rb-s0", "completed", {"mean_cv_score": 0.9, "attempt": 1}
+    )
+    store.record_steal(sid, "rb", "rb-s1", thief_shard=1, attempt=2)
+    store.record_migrate_out(sid, "rb", 1)
+    return sid
+
+
+def test_rebalance_journal_crash_point_fuzz(tmp_path):
+    """Replay must never raise wherever a crash truncated a journal
+    containing the rebalance ops, and the suffix must re-apply cleanly —
+    the same total-replay contract test_durability.py pins for the base
+    ops."""
+    jd_full = str(tmp_path / "full")
+    sid = _rebalance_journal(jd_full)
+    raw = open(os.path.join(jd_full, "jobs.jsonl"), "rb").read()
+    lines = raw.splitlines(keepends=True)
+    assert len(lines) >= 6  # every rebalance op type is present
+    full = JobStore(journal_dir=jd_full)
+    want = (
+        full.job_progress(sid, "rb"),
+        full.migrated_to("rb"),
+        sorted(full.steal_tombstones),
+    )
+    assert want[1] == 1 and want[2] == ["rb-s1"]
+
+    for i in range(len(lines) + 1):
+        jd = str(tmp_path / f"cut{i}")
+        os.makedirs(jd)
+        path = os.path.join(jd, "jobs.jsonl")
+        with open(path, "wb") as f:
+            f.writelines(lines[:i])
+        cut = JobStore(journal_dir=jd)  # must never raise
+        assert cut.replay_skipped == 0
+        with open(path, "ab") as f:
+            f.writelines(lines[i:])
+        resumed = JobStore(journal_dir=jd)
+        got = (
+            resumed.job_progress(sid, "rb"),
+            resumed.migrated_to("rb"),
+            sorted(resumed.steal_tombstones),
+        )
+        assert got == want
+
+
+# ---------------------------------------------------------------------
+# forwarding: donor 409 stamp + front-end redirect cache
+# ---------------------------------------------------------------------
+
+
+def test_forwarding_cache_ttl_and_eviction():
+    cache = ForwardingCache(ttl_s=0.05, max_entries=3)
+    cache.put("a", 1)
+    assert cache.get("a") == 1
+    time.sleep(0.06)
+    assert cache.get("a") is None  # expired entries drop on read
+    assert len(cache) == 0
+
+    cache = ForwardingCache(ttl_s=60.0, max_entries=3)
+    for i, j in enumerate(("a", "b", "c")):
+        cache.put(j, i)
+        time.sleep(0.002)  # distinct expiry order
+    cache.put("d", 3)  # overflow: soonest-to-expire ("a") evicted
+    assert len(cache) == 3
+    assert cache.get("a") is None
+    assert cache.get("d") == 3
+    # re-putting an existing key never evicts
+    cache.put("b", 9)
+    assert len(cache) == 3 and cache.get("b") == 9
+
+
+def test_donor_answers_409_moved_on_job_routes():
+    coord = Coordinator()
+    from werkzeug.test import Client
+
+    client = Client(create_app(coord))
+    sid = coord.create_session()
+    coord.store.create_job(sid, "gone", {}, [{"subtask_id": "gone-s0"}])
+    coord.store.record_migrate_out(sid, "gone", 1)
+
+    for path in (
+        f"/check_status/{sid}/gone",
+        f"/metrics/{sid}/gone",
+        f"/download_model/{sid}/gone",
+    ):
+        resp = client.get(path)
+        assert resp.status_code == 409, path
+        body = resp.get_json()
+        assert body["status"] == "moved"
+        assert body["migrated_to"] == 1
+        assert body["job_id"] == "gone"
+    # an SSE resume of the moved job redirects instead of resubmitting
+    resp = client.post(f"/train_status/{sid}", json={"job_id": "gone"})
+    assert resp.status_code == 409
+    assert resp.get_json()["migrated_to"] == 1
+
+
+def test_frontend_follows_forwarding_stamp_and_caches_it():
+    """Front end hits the hash-owning donor, learns the 409 stamp,
+    re-proxies once to the new owner, and serves subsequent requests
+    straight from the redirect cache (counter increments exactly once)."""
+    from werkzeug.test import Client
+
+    from cs230_distributed_machine_learning_tpu.runtime.frontend import (
+        create_frontend_app,
+    )
+
+    sid = str(uuid.uuid4())
+    while shard_of(sid, 2) != 0:
+        sid = str(uuid.uuid4())
+
+    donor, recipient = Coordinator(), Coordinator()
+    for c in (donor, recipient):
+        c.store.create_session(sid)
+    donor.store.create_job(sid, "moved", {}, [{"subtask_id": "moved-s0"}])
+    donor.store.record_migrate_out(sid, "moved", 1)
+    recipient.store.create_job(
+        sid, "moved", {}, [{"subtask_id": "moved-s0"}]
+    )
+    recipient.store.update_subtask(
+        sid, "moved", "moved-s0", "completed", {"mean_cv_score": 0.95}
+    )
+    recipient.store.finalize_job(
+        sid, "moved", {"results": [], "best_result": None}
+    )
+
+    srv0, url0 = _serve(donor)
+    srv1, url1 = _serve(recipient)
+    try:
+        fe = Client(create_frontend_app([url0, url1]))
+        before = _counter("tpuml_frontend_forwarded_total")
+        resp = fe.get(f"/check_status/{sid}/moved")
+        assert resp.status_code == 200
+        assert resp.get_json()["job_status"] == "completed"
+        assert _counter("tpuml_frontend_forwarded_total") == before + 1
+        # second request rides the cache: no fresh 409 round trip
+        resp = fe.get(f"/check_status/{sid}/moved")
+        assert resp.status_code == 200
+        assert _counter("tpuml_frontend_forwarded_total") == before + 1
+    finally:
+        srv0.shutdown()
+        srv1.shutdown()
+
+
+# ---------------------------------------------------------------------
+# shard pressure signal
+# ---------------------------------------------------------------------
+
+
+def test_shard_pressure_signal_present_and_bounded():
+    coord = Coordinator()
+    rep = coord.signals.evaluate(force=True)
+    sp = rep["signals"]["shard_pressure"]
+    assert isinstance(sp, float) and sp >= 0.0  # idle shard ≈ 0
+
+
+# ---------------------------------------------------------------------
+# live migration + stealing between in-process coordinators
+# ---------------------------------------------------------------------
+
+_GRID = {
+    "dataset_id": "iris",
+    "train_params": {"test_size": 0.2, "random_state": 0},
+}
+
+
+def _grid_payload(n: int):
+    return {
+        **_GRID,
+        "model_details": extract_model_details(
+            GridSearchCV(
+                LogisticRegression(max_iter=50),
+                {"C": [0.1, 1.0, 10.0, 100.0][:n]},
+                cv=3,
+            )
+        ),
+    }
+
+
+def _wait_queued(cluster, n, timeout_s=30):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        depth = sum(
+            len(q) for q in cluster.engine.queue_snapshot().values()
+        )
+        if depth >= n:
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"never saw {n} queued subtasks")
+
+
+def test_migrate_job_between_live_coordinators():
+    """Quiesce → fence → export → adopt, end to end over real HTTP: the
+    donor's queued job (its only worker never executes) moves to a peer
+    with a live executor, which finishes it; the donor answers 409
+    moved and releases the fenced queue entries."""
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+
+    materialize_builtin("iris")
+    get_config().service.rebalance_enabled = True
+
+    cluster_a = ClusterRuntime(shard_id=0)
+    cluster_a.register_remote(None)  # queued work parks here forever
+    donor = Coordinator(cluster=cluster_a, shard_id=0, n_shards=2)
+    cluster_b = ClusterRuntime(shard_id=1)
+    cluster_b.add_executor()
+    recipient = Coordinator(cluster=cluster_b, shard_id=1, n_shards=2)
+    srv_a, url_a = _serve(donor)
+    srv_b, url_b = _serve(recipient)
+    donor.peer_urls = [url_a, url_b]
+    recipient.peer_urls = [url_a, url_b]
+    try:
+        sid = donor.create_session()
+        submit = donor.submit_train(sid, _grid_payload(2))
+        jid = submit["job_id"]
+        _wait_queued(cluster_a, 2)
+
+        before_in = _counter("tpuml_jobs_migrated_total", direction="in")
+        assert donor.migrate_job(sid, jid, 1) is True
+        assert donor.store.migrated_to(jid) == 1
+        # fenced queue entries were released from the donor's books
+        assert sum(
+            len(q) for q in cluster_a.engine.queue_snapshot().values()
+        ) == 0
+        # the donor's REST surface forwards
+        r = requests.get(f"{url_a}/check_status/{sid}/{jid}", timeout=10)
+        assert r.status_code == 409
+        assert r.json()["migrated_to"] == 1
+        assert _counter(
+            "tpuml_jobs_migrated_total", direction="in"
+        ) == before_in + 1
+
+        # the recipient finishes the adopted job with the full trial set
+        assert recipient.store.wait_job(sid, jid, timeout=120)
+        status = recipient.check_status(sid, jid)
+        assert status["job_status"] == "completed"
+        # the RECIPIENT's REST surface serves the adopted job under the
+        # DONOR's stamp: canonical_job_id must pass s00-… through, not
+        # re-wrap it into s01-s00-… (never stored — every poll would 404
+        # and a forwarded client would hang on a finished job)
+        assert recipient.canonical_job_id(jid) == jid
+        r = requests.get(f"{url_b}/check_status/{sid}/{jid}", timeout=10)
+        assert r.status_code == 200
+        assert r.json()["job_status"] == "completed"
+        results = status["job_result"]["results"]
+        assert len(results) == 2
+        assert len({r["subtask_id"] for r in results}) == 2  # no dupes
+        # every migrated subtask ran under a FENCED (bumped) attempt
+        job = recipient.store.get_job(sid, jid)
+        assert all(
+            int(s["spec"].get("attempt") or 0) >= 1
+            for s in job["subtasks"].values()
+        )
+    finally:
+        srv_a.shutdown()
+        srv_b.shutdown()
+        cluster_a.shutdown()
+        cluster_b.shutdown()
+
+
+def test_steal_grant_fences_tombstones_and_results_settle():
+    """Donor-side stealing contract: only non-head queued subtasks are
+    offered, grants carry bumped attempts + journaled tombstones +
+    released queue entries, relayed peer results settle the job, and the
+    disabled valve offers nothing."""
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+
+    materialize_builtin("iris")
+    svc = get_config().service
+    cluster = ClusterRuntime(shard_id=0)
+    cluster.register_remote(None)  # tasks queue deterministically
+    coord = Coordinator(cluster=cluster, shard_id=0, n_shards=2)
+    try:
+        sid = coord.create_session()
+        submit = coord.submit_train(sid, _grid_payload(4))
+        jid = submit["job_id"]
+        _wait_queued(cluster, 4)
+
+        # disabled valve: no offers, no grants
+        assert coord.steal_candidates()["candidates"] == []
+        assert coord.release_for_steal(1, 8) == []
+
+        svc.rebalance_enabled = True
+        svc.rebalance_hot_pressure = 0.0  # any pressure counts as hot
+        coord.signals.evaluate(force=True)
+        offer = coord.steal_candidates()
+        assert offer["shard_pressure"] is not None
+        offered = {c["subtask_id"] for c in offer["candidates"]}
+        assert len(offered) == 3  # queue head is withheld
+
+        granted = coord.release_for_steal(1, max_n=8)
+        granted_ids = {t["subtask_id"] for t in granted}
+        assert granted_ids == offered
+        assert all(int(t.get("attempt") or 0) >= 1 for t in granted)
+        assert all(t["stolen_from"] == 0 for t in granted)
+        assert set(coord.store.steal_tombstones) == granted_ids
+        # grants left the donor's books; only the head remains queued
+        assert sum(
+            len(q) for q in cluster.engine.queue_snapshot().values()
+        ) == 1
+        # granted subtasks no longer offered
+        assert coord.steal_candidates()["candidates"] == []
+
+        # the thief relays results home through /peer_result's ingest;
+        # the head never executes, so relay one result per subtask
+        job = coord.store.get_job(sid, jid)
+        for stid, sub in job["subtasks"].items():
+            coord.ingest_peer_result({
+                "subtask_id": stid,
+                "job_id": jid,
+                "status": "completed",
+                "mean_cv_score": 0.9,
+                "accuracy": 0.9,
+                "attempt": int(sub["spec"].get("attempt") or 0),
+            })
+        assert coord.store.wait_job(sid, jid, timeout=60)
+        assert coord.store.steal_tombstones == {}  # results settle grants
+        status = coord.check_status(sid, jid)
+        assert status["job_status"] == "completed"
+        assert len(status["job_result"]["results"]) == 4
+    finally:
+        cluster.shutdown()
+
+
+def test_stale_steal_lease_reclaims_subtask():
+    """A thief that goes dark: once the lease expires the donor clears
+    the tombstone, bumps the attempt (fencing any resurrected thief),
+    and re-queues the subtask locally."""
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+
+    materialize_builtin("iris")
+    svc = get_config().service
+    svc.rebalance_enabled = True
+    svc.rebalance_hot_pressure = 0.0
+    cluster = ClusterRuntime(shard_id=0)
+    cluster.register_remote(None)
+    coord = Coordinator(cluster=cluster, shard_id=0, n_shards=2)
+    try:
+        sid = coord.create_session()
+        coord.submit_train(sid, _grid_payload(2))
+        _wait_queued(cluster, 2)
+        coord.signals.evaluate(force=True)
+
+        granted = coord.release_for_steal(1, max_n=1)
+        assert len(granted) == 1
+        stid = granted[0]["subtask_id"]
+        attempt = int(granted[0].get("attempt") or 0)
+        assert stid in coord.store.steal_tombstones
+
+        svc.steal_lease_s = 0.0  # every outstanding lease is now stale
+        coord._reclaim_stale_steals()
+        assert stid not in coord.store.steal_tombstones
+        # re-queued locally under a fresh fencing attempt
+        _wait_queued(cluster, 2)
+        queued = {
+            s for q in cluster.engine.queue_snapshot().values() for s in q
+        }
+        assert stid in queued
+        info = coord.store.lookup_specs([stid])
+        assert int(info[stid]["spec"].get("attempt") or 0) > attempt
+    finally:
+        cluster.shutdown()
+
+def test_late_result_forwarding_relays_each_subtask_once():
+    """The donor's post-migration relay is bounded: N duplicate reports
+    for one open subtask produce exactly ONE /peer_result POST. Without
+    the bound, migrating a job after granting a steal from it lets the
+    donor's forward pump and the thief's relay pump ping-pong the same
+    result between the shards until both deadlines expire."""
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        TOPIC_RESULTS,
+    )
+
+    cluster_a = ClusterRuntime(shard_id=0)
+    donor = Coordinator(cluster=cluster_a, shard_id=0, n_shards=2)
+    cluster_b = ClusterRuntime(shard_id=1)
+    recipient = Coordinator(cluster=cluster_b, shard_id=1, n_shards=2)
+    srv_b, url_b = _serve(recipient)
+    donor.peer_urls = ["", url_b]
+    try:
+        before_fwd = _counter("tpuml_results_forwarded_total")
+        before_in = _counter("tpuml_peer_results_ingested_total")
+        # pump subscribes synchronously, so publishes after this land
+        donor._forward_late_results("job-x", 1, ["st-dup"])
+        for _ in range(5):
+            donor.bus.publish(
+                TOPIC_RESULTS,
+                {"subtask_id": "st-dup", "status": "completed"},
+                key="st-dup",
+            )
+        deadline = time.time() + 10
+        while (
+            _counter("tpuml_results_forwarded_total") == before_fwd
+            and time.time() < deadline
+        ):
+            time.sleep(0.05)
+        time.sleep(1.0)  # window for any (buggy) duplicate relays
+        assert _counter("tpuml_results_forwarded_total") == before_fwd + 1
+        assert _counter("tpuml_peer_results_ingested_total") == before_in + 1
+    finally:
+        srv_b.shutdown()
+        cluster_a.shutdown()
+        cluster_b.shutdown()
