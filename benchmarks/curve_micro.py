@@ -1,0 +1,245 @@
+"""Micro-benchmark: the trial telemetry plane's acceptance gates (ISSUE 20).
+
+Three sections -> benchmarks/CURVE_MICRO.json:
+
+- **overhead**: timed ``LocalExecutor.run_subtasks`` passes on a small
+  LogisticRegression batch with ``CS230_CURVES`` alternating on/off in
+  interleaved pairs (the logreg_profile round-robin methodology — the
+  delta is the signal, sequential best-of lets machine drift swamp it).
+  Gate: the enabled capture costs <= 3 % over the strict-no-op off
+  state, or the delta sits inside run-to-run noise. Both states are
+  warmed separately — the valve joins ``trace_salt``, so on/off compile
+  distinct executables and the warm pass keeps compilation out of the
+  measurement.
+- **watchdog**: an ASHA MLP search with one deliberately diverging
+  learning rate (sgd, lr=1e6 -> non-finite loss inside rung 0). Gate:
+  the trial terminates as ``diverged`` (never ``failed``) having
+  consumed < 30 % of its ``max_resource`` step budget.
+- **parity**: the same search under ``CS230_CURVES=0`` (no capture, no
+  watchdog). Gate: the surviving winner's config and score match the
+  watchdog run — the telemetry plane observes fits, it must not change
+  them.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/curve_micro.py
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PASSES = 7
+N_TRIALS = 6
+OVERHEAD_GATE = 0.03
+BUDGET_GATE = 0.30
+
+
+def _stats(xs):
+    med = statistics.median(xs)
+    return {
+        "median_s": med,
+        "min_s": min(xs),
+        "spread": (max(xs) - min(xs)) / med if med else None,
+        "samples": xs,
+    }
+
+
+def _overhead_section():
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.executor import (
+        LocalExecutor,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.subtasks import (
+        create_subtasks,
+    )
+
+    materialize_builtin("iris")
+    executor = LocalExecutor()
+    subtasks = create_subtasks(
+        "curve-micro", "sess", "iris",
+        {
+            "model_type": "LogisticRegression",
+            "search_type": "GridSearchCV",
+            "base_estimator_params": {"max_iter": 200},
+            "param_grid": {"C": [0.1 * (i + 1) for i in range(N_TRIALS)]},
+        },
+        {"test_size": 0.2, "random_state": 0, "cv": 3},
+    )
+
+    def timed_run():
+        t0 = time.perf_counter()
+        results = executor.run_subtasks([dict(st) for st in subtasks])
+        assert all(r["status"] == "completed" for r in results)
+        return time.perf_counter() - t0
+
+    # warm BOTH states: trace_salt keys distinct executables per state
+    for state in ("0", "auto"):
+        os.environ["CS230_CURVES"] = state
+        timed_run()
+
+    samples = {"0": [], "auto": []}
+    for i in range(2 * N_PASSES):
+        state = "0" if i % 2 == 0 else "auto"  # alternate to cancel drift
+        os.environ["CS230_CURVES"] = state
+        samples[state].append(timed_run())
+
+    off, on = _stats(samples["0"]), _stats(samples["auto"])
+    overhead = (
+        (on["median_s"] - off["median_s"]) / off["median_s"]
+        if off["median_s"] else None
+    )
+    noise = max(off["spread"] or 0, on["spread"] or 0)
+    ok = overhead is not None and (
+        overhead <= OVERHEAD_GATE or overhead <= noise
+    )
+    return {
+        "off_CS230_CURVES_0": off,
+        "on_CS230_CURVES_auto": on,
+        "on_minus_off_relative": overhead,
+        "noise_floor": noise,
+        "gate": f"overhead <= {OVERHEAD_GATE} (or within noise)",
+        "pass": bool(ok),
+    }, ok
+
+
+def _search_job():
+    # one lr that explodes inside rung 0; the rest converge, with a
+    # clearly best config so the winner is ordering-independent
+    return {
+        "model_type": "MLPClassifier",
+        "search_type": "asha",
+        "base_estimator_params": {
+            "hidden_layer_sizes": (8,),
+            "solver": "sgd",
+            "random_state": 0,
+        },
+        "param_grid": {"learning_rate_init": [0.05, 0.02, 0.01, 1e6]},
+        "cv_params": {"cv": 2},
+        "n_iter": 4,
+        "asha": {"eta": 3, "min_resource": 20, "max_resource": 180},
+    }
+
+
+def _run_search(curves_state):
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        ClusterRuntime,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+
+    os.environ["CS230_CURVES"] = curves_state
+    cluster = ClusterRuntime()
+    try:
+        cluster.add_executor()
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(_search_job(), "iris", show_progress=False,
+                         timeout=600)
+        assert status["job_status"] == "completed", status["job_status"]
+        return status["job_result"]
+    finally:
+        cluster.shutdown()
+
+
+def _watchdog_section():
+    jr = _run_search("auto")
+    diverged = jr.get("diverged_results") or []
+    max_resource = 180
+    fractions = []
+    for r in diverged:
+        asha = r.get("asha") or {}
+        rung = int(asha.get("rung") or 0)
+        # cold-restart rungs: steps consumed = sum of entered rung budgets
+        consumed = sum(20 * (3 ** k) for k in range(rung + 1))
+        fractions.append(consumed / max_resource)
+    ok = (
+        len(diverged) >= 1
+        and all(r["status"] == "diverged" for r in diverged)
+        and jr.get("failed") == []
+        and all(f < BUDGET_GATE for f in fractions)
+    )
+    section = {
+        "n_diverged": len(diverged),
+        "diverged_params": [r.get("parameters", {}).get("learning_rate_init")
+                            for r in diverged],
+        "budget_fraction_consumed": fractions,
+        "gate": f"diverging lr terminates as 'diverged' under "
+                f"{BUDGET_GATE:.0%} of max_resource, zero failures",
+        "pass": bool(ok),
+    }
+    return section, ok, jr
+
+
+def _parity_section(jr_on):
+    jr_off = _run_search("0")
+    best_on, best_off = jr_on["best_result"], jr_off["best_result"]
+    same_cfg = (
+        best_on["parameters"].get("learning_rate_init")
+        == best_off["parameters"].get("learning_rate_init")
+    )
+    score_on = best_on.get("mean_cv_score")
+    score_off = best_off.get("mean_cv_score")
+    ok = same_cfg and score_on == score_off
+    return {
+        "winner_lr_watchdog_on": best_on["parameters"].get(
+            "learning_rate_init"),
+        "winner_lr_watchdog_off": best_off["parameters"].get(
+            "learning_rate_init"),
+        "winner_score_watchdog_on": score_on,
+        "winner_score_watchdog_off": score_off,
+        "gate": "winning config + score identical with capture disabled",
+        "pass": bool(ok),
+    }, ok
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    prior = os.environ.get("CS230_CURVES")
+    try:
+        overhead, ok_overhead = _overhead_section()
+        watchdog, ok_watchdog, jr_on = _watchdog_section()
+        parity, ok_parity = _parity_section(jr_on)
+    finally:
+        if prior is None:
+            os.environ.pop("CS230_CURVES", None)
+        else:
+            os.environ["CS230_CURVES"] = prior
+
+    import jax
+
+    out = {
+        "benchmark": "curve_micro",
+        "backend": jax.default_backend(),
+        "config": {"n_trials": N_TRIALS, "passes_per_state": N_PASSES,
+                   "dataset": "iris", "overhead_model": "LogisticRegression",
+                   "watchdog_model": "MLPClassifier/sgd",
+                   "asha": {"eta": 3, "min_resource": 20,
+                            "max_resource": 180}},
+        "overhead": overhead,
+        "watchdog": watchdog,
+        "parity": parity,
+        "gates": {
+            "overhead_within_3pct_or_noise": bool(ok_overhead),
+            "diverged_under_30pct_budget": bool(ok_watchdog),
+            "survivor_parity": bool(ok_parity),
+        },
+        "pass": bool(ok_overhead and ok_watchdog and ok_parity),
+    }
+    path = os.path.join(os.path.dirname(__file__), "CURVE_MICRO.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    json.dump(out, sys.stdout, indent=2)
+    print()
+    if not out["pass"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -649,6 +649,14 @@ def cash_in(
                        "host->HBM overlap via streaming_micro.py",
         }
 
+    # trial telemetry plane gates (ISSUE 20): capture overhead <= 3%,
+    # diverging-lr watchdog under 30% budget, survivor parity — backend-
+    # independent, so it runs everywhere
+    sections["curve_micro"] = _run_sub(
+        [py, "benchmarks/curve_micro.py"], 1200,
+        artifact="benchmarks/CURVE_MICRO.json",
+    )
+
     sections["valve_ab"] = {"components": components, "skipped": comp_skipped}
     return sections
 

@@ -302,6 +302,10 @@ class MLTaskManager:
             for progress in self._coordinator.stream_status(
                 self.session_id, self.job_id
             ):
+                if progress.get("kind") == "curve":
+                    # interleaved learning-curve event (trial telemetry
+                    # plane) — not a progress snapshot; read via curves()
+                    continue
                 last = progress
                 if bar is not None:
                     bar.n = int(_pct(progress.get("job_status")))
@@ -395,6 +399,11 @@ class MLTaskManager:
                         except ValueError:
                             # a torn event (connection died mid-write):
                             # the stream is about to end — resume path
+                            continue
+                        if event.get("kind") == "curve":
+                            # interleaved learning-curve SSE event — skip
+                            # (progress bars want snapshots; curves())
+                            attempt = 0
                             continue
                         last = event
                         attempt = 0  # real progress resets the backoff
@@ -518,6 +527,44 @@ class MLTaskManager:
                 raise KeyError(f"no critical path for job {jid!r}") from e
             raise
 
+    def curves(
+        self, job_id: Optional[str] = None, subtask_id: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """Learning curves captured in-fit for a job — or one trial when
+        ``subtask_id`` is given (docs/OBSERVABILITY.md "Trial telemetry
+        plane"). Each entry carries the downsampled per-split trace
+        (loss / score / grad-norm channels), its rung/attempt, and the
+        numerical-health watchdog's ``diverged`` flag. ``job_id``
+        defaults to the latest ``train()``; raises KeyError when the
+        coordinator has no curves for the pair (unknown ids, or a run
+        under ``CS230_CURVES=0`` returns an empty job-level list but a
+        404/KeyError for a subtask)."""
+        jid = job_id or self.job_id
+        if jid is None:
+            raise TypeError("curves() requires a job id (or a prior train())")
+        if self._coordinator is not None:
+            if subtask_id is not None:
+                return self._coordinator.subtask_curves(jid, subtask_id)
+            out = self._coordinator.job_curves(jid)
+            if out is None:
+                raise KeyError(f"no job {jid!r}")
+            return out
+        import requests
+
+        path = f"curves/{jid}" if subtask_id is None else (
+            f"curves/{jid}/{subtask_id}"
+        )
+        try:
+            return self._request("get", path)
+        except requests.HTTPError as e:
+            if e.response is not None and e.response.status_code == 404:
+                # same contract as local mode: absence is a KeyError
+                raise KeyError(
+                    f"no curves for job {jid!r}"
+                    + (f" subtask {subtask_id!r}" if subtask_id else "")
+                ) from e
+            raise
+
     def best_result(self, job_id: Optional[str] = None) -> Optional[Dict[str, Any]]:
         status = self.check_status(job_id)
         result = status.get("job_result") or {}
@@ -629,12 +676,15 @@ def _bar_postfix(bar, progress: Dict[str, Any]) -> None:
     and the highest active rung ride the tqdm postfix so a user watching
     the bar sees the controller working, not just percent-done."""
     pruned = progress.get("tasks_pruned")
+    diverged = progress.get("tasks_diverged")
     search = progress.get("search")
-    if not pruned and not search:
+    if not pruned and not diverged and not search:
         return
     post = {}
     if pruned:
         post["pruned"] = pruned
+    if diverged:
+        post["diverged"] = diverged
     if isinstance(search, dict):
         rungs = [
             r

@@ -66,10 +66,15 @@ class LogisticRegressionKernel(ModelKernel):
         values collapse to the same behavior and must share a cache key.
         CS230_STREAM joins them (resolved off/auto/force): the streamed
         and single-shot drivers stage different dataset forms, so every
-        executable/prepared cache must re-key when the valve moves."""
+        executable/prepared cache must re-key when the valve moves.
+        CS230_CURVES joins too: with capture on, the solver scans carry
+        a trace buffer and emit extra outputs, so flipping the valve (or
+        CS230_CURVE_POINTS) must re-key every executable cache."""
         from ..data.streaming import stream_mode
+        from ..obs.curves import curves_salt
 
-        return (_masked_grad_mode(), _fused_step_mode(), stream_mode())
+        return (_masked_grad_mode(), _fused_step_mode(), stream_mode(),
+                curves_salt())
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
         if static.get("penalty") not in ("l2", None, "none"):
@@ -86,6 +91,18 @@ class LogisticRegressionKernel(ModelKernel):
         return {**static, "_method": method}
 
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        return self._fit(X, y, w, hyper, static, trace=False)[0]
+
+    def fit_curve(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        """Capture hook (docs/OBSERVABILITY.md "Trial telemetry plane"):
+        same fit, plus a bounded grad-norm trace written from inside the
+        solver scan — one f32 sample per ``stride`` iterations, at most
+        ``CS230_CURVE_POINTS`` slots. Returns ``(params, curve)`` with
+        ``curve = {"gmax": [P'], "stride": scalar, "steps": scalar}``."""
+        return self._fit(X, y, w, hyper, static, trace=True)
+
+    def _fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any],
+             trace: bool):
         n_classes = int(static["_n_classes"])
         c = max(n_classes, 2)
         fit_intercept = bool(static.get("fit_intercept", True))
@@ -124,13 +141,23 @@ class LogisticRegressionKernel(ModelKernel):
         mode = _masked_grad_mode()
         if static["_method"] == "newton":
             steps = int(static.get("_iters", _NEWTON_STEPS))
-            W = _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
-                        steps, fused=(mode != "legacy"))
+            W, tr = _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
+                            steps, fused=(mode != "legacy"), trace=trace)
         else:
             steps = int(static.get("_iters", _NESTEROV_STEPS))
             grad_fn = _make_masked_grad_fn(A, Y, y, w, C, lam, pen_mask, mm, mode)
-            W = _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps)
-        return W
+            W, tr = _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps,
+                              trace=trace)
+        if not trace:
+            return W, None
+        from ..obs.curves import trace_stride
+
+        stride = trace_stride(steps)
+        return W, {
+            "gmax": tr,
+            "stride": jnp.asarray(float(stride), jnp.float32),
+            "steps": jnp.asarray(float(steps), jnp.float32),
+        }
 
     def bucket_static(self, static: Dict[str, Any], hypers) -> Dict[str, Any]:
         """Engine hook: with the bucket's hyper values known, cap the static
@@ -331,6 +358,11 @@ class LogisticRegressionKernel(ModelKernel):
         use_fused = mode == "pallas" or (
             mode == "auto" and fused_step_applicable(dpp, NB, bm)
         )
+        from ..obs.curves import curves_enabled, trace_stride
+
+        capture = curves_enabled()
+        tr_stride = trace_stride(steps) if capture else 1
+        tr_used = -(-steps // tr_stride) if capture else 0
 
         # static column maps: block col j -> (split, trial-in-block)
         j = np.arange(Bblk)
@@ -387,11 +419,16 @@ class LogisticRegressionKernel(ModelKernel):
             # all-converged early exit measures ~20% SLOWER here: the
             # per-step cond reduce acts as a barrier, and slow-converging
             # trials run to max_iter anyway.
+            tr0 = (
+                jnp.zeros((tr_used, n_wb, Bblk), jnp.float32)
+                if capture else None
+            )
+
             if use_fused:
                 pen_col = pen_row_j[0]  # [dpp, 1]
 
                 def body(carry, t):
-                    W, Wp, done = carry
+                    W, Wp, done, tr = carry
                     W, Wp, gmax = packed_nesterov_step(
                         Ab, W, Wp, y2, WSP, t, done.astype(jnp.float32),
                         step_b, Cb, maxit_b, pen_col,
@@ -399,14 +436,16 @@ class LogisticRegressionKernel(ModelKernel):
                         interpret=interpret,
                     )
                     done = jnp.logical_or(done, gmax < tol_b)
-                    return (W, Wp, done), None
+                    if capture:
+                        tr = tr.at[jnp.asarray(t, jnp.int32) // tr_stride].set(gmax)
+                    return (W, Wp, done, tr), None
 
             else:
                 step_full = jnp.tile(step_b, (1, c))[:, None, :]  # [n_wb,1,NB]
                 Cb_full = jnp.tile(Cb, (1, c))[:, None, :]
 
                 def body(carry, t):  # legacy scan body — parity reference
-                    W, Wp, done = carry
+                    W, Wp, done, tr = carry
                     mom = t / (t + 3.0)
                     V = W + mom * (W - Wp)
                     Graw = packed_softmax_grad(
@@ -424,10 +463,12 @@ class LogisticRegressionKernel(ModelKernel):
                     W_new = jnp.where(act, V - step_full * G, W)
                     Wp_new = jnp.where(act, W, Wp)
                     done = jnp.logical_or(done, gmax < tol_b)
-                    return (W_new, Wp_new, done), None
+                    if capture:
+                        tr = tr.at[jnp.asarray(t, jnp.int32) // tr_stride].set(gmax)
+                    return (W_new, Wp_new, done, tr), None
 
-            (W, _, _), _ = jax.lax.scan(
-                body, (W0, W0, done0), jnp.arange(steps, dtype=jnp.float32)
+            (W, _, _, tr_out), _ = jax.lax.scan(
+                body, (W0, W0, done0, tr0), jnp.arange(steps, dtype=jnp.float32)
             )
 
             # ---- eval: streamed row chunks, argmax over the class axis ----
@@ -460,7 +501,24 @@ class LogisticRegressionKernel(ModelKernel):
             den = jnp.maximum(jnp.sum(EW.astype(jnp.float32), axis=1), 1e-12)  # [S]
             score_b = acc / den[split_of_j][None, :]
             score = score_b.reshape(n_wb, S, Tw).transpose(0, 2, 1).reshape(chunk, S)
-            return {"score": score}
+            out = {"score": score}
+            if capture:
+                # same lane->(trial, split) mapping as score, with the
+                # trace-slot axis carried along as a trailing dim
+                curve = (
+                    tr_out.transpose(1, 2, 0)
+                    .reshape(n_wb, S, Tw, tr_used)
+                    .transpose(0, 2, 1, 3)
+                    .reshape(chunk, S, tr_used)
+                )
+                out["curve_gmax"] = curve
+                out["curve_stride"] = jnp.full(
+                    (chunk, S), float(tr_stride), jnp.float32
+                )
+                out["curve_steps"] = jnp.full(
+                    (chunk, S), float(steps), jnp.float32
+                )
+            return out
 
         return fn
 
@@ -622,8 +680,22 @@ def _make_masked_grad_fn(A, Y, y, w, C, lam, pen_mask, mm, mode):
     return grad_fn
 
 
+def _trace_buf(steps, trace, shape=()):
+    """(stride, buffer) for an in-scan grad-norm trace; ``(1, None)``
+    when capture is off — ``None`` is an empty pytree, so the scan carry
+    and jaxpr are bit-identical to the pre-curves path (the strict no-op
+    contract tests/test_obs.py pins)."""
+    if not trace:
+        return 1, None
+    from ..obs.curves import trace_stride
+
+    stride = trace_stride(int(steps))
+    used = -(-int(steps) // stride)
+    return stride, jnp.zeros((used,) + tuple(shape), jnp.float32)
+
+
 def _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
-            steps=_NEWTON_STEPS, fused=True):
+            steps=_NEWTON_STEPS, fused=True, trace=False):
     n, dp = A.shape
     c = Y.shape[1]
     dim = dp * c
@@ -654,8 +726,10 @@ def _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
         G = mm(A.T, WP - WYc) + lam * pen_mask * W
         return G, P, WP
 
+    stride, tr0 = _trace_buf(steps, trace)
+
     def step(carry, t):
-        W, done = carry
+        W, done, tr = carry
         G, P, WP = grad_and_P(W)
         # Hessian: H[(i,a),(j,b)] = sum_n wc_n A_ni A_nj (P_na δab − P_na P_nb)
         # block-diagonal part: per class a, A' diag(wc * P_a) A == A' diag(WP_a) A
@@ -686,15 +760,19 @@ def _newton(A, Y, w, W0, mm, C, lam, pen_mask, max_iter, tol,
         take = jnp.logical_and(active, alpha > 0.0)
         W = jnp.where(take, W - alpha * delta, W)
         done = jnp.logical_or(done, gmax < tol)
-        return (W, done), None
+        if trace:
+            tr = tr.at[jnp.asarray(t, jnp.int32) // stride].set(gmax)
+        return (W, done, tr), None
 
-    (W, _), _ = jax.lax.scan(
-        step, (W0, jnp.asarray(False)), jnp.arange(steps, dtype=jnp.float32)
+    (W, _, tr), _ = jax.lax.scan(
+        step, (W0, jnp.asarray(False), tr0),
+        jnp.arange(steps, dtype=jnp.float32)
     )
-    return W
+    return W, tr
 
 
-def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps=_NESTEROV_STEPS):
+def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps=_NESTEROV_STEPS,
+              trace=False):
     # Lipschitz bound: L <= 0.5 * C * lambda_max(A' diag(w) A) + lam
     v = jnp.ones((A.shape[1],), jnp.float32)
 
@@ -707,8 +785,10 @@ def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps=_NESTEROV_STEPS):
     L = 0.5 * C * lam_max + lam + 1e-6
     step = 1.0 / L
 
+    stride, tr0 = _trace_buf(steps, trace)
+
     def body(carry, t):
-        W, W_prev, done = carry
+        W, W_prev, done, tr = carry
         mom = t / (t + 3.0)
         V = W + mom * (W - W_prev)
         G, _ = grad_fn(V)
@@ -717,14 +797,19 @@ def _nesterov(A, w, W0, grad_fn, C, lam, max_iter, tol, steps=_NESTEROV_STEPS):
         W_new = jnp.where(active, V - step * G, W)
         W_prev_new = jnp.where(active, W, W_prev)
         done = jnp.logical_or(done, gmax < tol)
-        return (W_new, W_prev_new, done), None
+        if trace:
+            # gmax is evaluated unconditionally even once the lane is
+            # done/past max_iter (the update is what's masked), so the
+            # trace tail freezes at the converged gradient norm
+            tr = tr.at[jnp.asarray(t, jnp.int32) // stride].set(gmax)
+        return (W_new, W_prev_new, done, tr), None
 
-    (W, _, _), _ = jax.lax.scan(
+    (W, _, _, tr), _ = jax.lax.scan(
         body,
-        (W0, W0, jnp.asarray(False)),
+        (W0, W0, jnp.asarray(False), tr0),
         jnp.arange(steps, dtype=jnp.float32),
     )
-    return W
+    return W, tr
 
 
 # ---------------- out-of-core streamed Nesterov driver ----------------

@@ -95,10 +95,16 @@ class _MLPBase(ModelKernel):
         change the compiled program without landing in ``static``. The
         salt carries the EFFECTIVE boolean, not the raw string: only the
         exact value "1" changes pick_k, so "0"/"yes"/unset must share one
-        cache key (a raw-string salt would force spurious retraces)."""
+        cache key (a raw-string salt would force spurious retraces).
+        CS230_CURVES joins: with capture on the Adam/SGD scans carry
+        trace buffers and ``fit`` routes through value_and_grad, so the
+        valve (and CS230_CURVE_POINTS) must re-key executables."""
+        from ..obs.curves import curves_salt
+
         return (
             "1" if os.environ.get("CS230_MLP_K16") == "1" else "",
             _v_dtype_mode(),
+            curves_salt(),
         )
 
     def resolve_static(self, static: Dict[str, Any], n: int, d: int, n_classes: int):
@@ -183,6 +189,20 @@ class _MLPBase(ModelKernel):
         return mm(h, params[-1]["W"]) + params[-1]["b"]
 
     def fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        return self._fit(X, y, w, hyper, static, trace=False)[0]
+
+    def fit_curve(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any]):
+        """Capture hook (docs/OBSERVABILITY.md "Trial telemetry plane"):
+        same fit, plus bounded in-scan traces — per-step loss and
+        grad-norm on the Adam path (``jax.value_and_grad`` replaces
+        ``jax.grad``; the loss's forward pass is shared with the gradient
+        so the extra cost is the two trace writes), per-epoch loss on the
+        SGD path (already computed for the adaptive schedule). Returns
+        ``(params, curve)``."""
+        return self._fit(X, y, w, hyper, static, trace=True)
+
+    def _fit(self, X, y, w, hyper: Dict[str, Any], static: Dict[str, Any],
+             trace: bool):
         X = X.astype(jnp.float32)
         w = w.astype(jnp.float32)
         n, d = X.shape
@@ -230,7 +250,18 @@ class _MLPBase(ModelKernel):
             l2 = sum(jnp.sum(layer["W"] ** 2) for layer in p)
             return data_loss + 0.5 * alpha * l2 / batch_w
 
-        grad_fn = jax.grad(loss_fn)
+        grad_fn = jax.value_and_grad(loss_fn) if trace else jax.grad(loss_fn)
+
+        total_steps = epochs * n_batches
+        if trace:
+            from ..obs.curves import trace_stride
+
+            tr_stride = trace_stride(total_steps)
+            tr_used = -(-total_steps // tr_stride)
+            tr0 = (jnp.zeros((tr_used,), jnp.float32),
+                   jnp.zeros((tr_used,), jnp.float32))
+        else:
+            tr_stride, tr0 = 1, None
 
         bf16 = jnp.bfloat16
         v_bf16 = _v_dtype_mode() == "bf16"
@@ -241,12 +272,21 @@ class _MLPBase(ModelKernel):
         sr_key = jax.random.fold_in(key, 0x5A)  # stochastic-rounding stream
 
         def step(carry, inp):
-            p, m, v, t = carry
+            p, m, v, t, tr = carry
             idx = inp
             xb = X[idx]
             tb = target[idx]
             wb = w[idx]
-            g = grad_fn(p, xb, tb, wb)
+            if trace:
+                loss, g = grad_fn(p, xb, tb, wb)
+                gmax = jnp.max(jnp.asarray(
+                    [jnp.max(jnp.abs(leaf))
+                     for leaf in jax.tree_util.tree_leaves(g)]
+                ))
+                ti = jnp.asarray(t, jnp.int32) // tr_stride
+                tr = (tr[0].at[ti].set(loss), tr[1].at[ti].set(gmax))
+            else:
+                g = grad_fn(p, xb, tb, wb)
             t = t + 1.0
             # moment math in f32, storage in bf16 (carry dtype)
             m = jax.tree_util.tree_map(
@@ -277,7 +317,7 @@ class _MLPBase(ModelKernel):
             p = jax.tree_util.tree_map(
                 lambda a, mh, vh: a - lr * mh / (jnp.sqrt(vh) + eps), p, mhat, vhat
             )
-            return (p, m, v, t), None
+            return (p, m, v, t, tr), None
 
         # precompute shuffled batch indices for all epochs: [epochs*n_batches, bs]
         def epoch_perm(k):
@@ -289,15 +329,23 @@ class _MLPBase(ModelKernel):
         if static.get("solver", "adam") == "sgd":
             return self._fit_sgd(
                 X, target, w, params, batches.reshape(epochs, n_batches, bs),
-                loss_fn, lr, static, n,
+                loss_fn, lr, static, n, trace=trace,
             )
 
-        (params, _, _, _), _ = jax.lax.scan(
-            step, (params, m0, v0, jnp.asarray(0.0)), batches
+        (params, _, _, _, tr), _ = jax.lax.scan(
+            step, (params, m0, v0, jnp.asarray(0.0), tr0), batches
         )
-        return params
+        if not trace:
+            return params, None
+        return params, {
+            "loss": tr[0],
+            "gmax": tr[1],
+            "stride": jnp.asarray(float(tr_stride), jnp.float32),
+            "steps": jnp.asarray(float(total_steps), jnp.float32),
+        }
 
-    def _fit_sgd(self, X, target, w, params, batches, loss_fn, lr0, static, n):
+    def _fit_sgd(self, X, target, w, params, batches, loss_fn, lr0, static, n,
+                 trace=False):
         """sklearn SGDOptimizer semantics: velocity momentum (plain or
         Nesterov) with the three learning-rate schedules —
         ``constant``; ``invscaling`` lr = lr_init / (t+1)^power_t with t
@@ -325,12 +373,25 @@ class _MLPBase(ModelKernel):
                 p = tmap(lambda a, v: a + v, p, vel)
             return (p, vel, lr_t), loss
 
-        def epoch_step(carry, ebatches):
-            p, vel, lr_t, t_samples, best, wait = carry
+        epochs = int(batches.shape[0])
+        if trace:
+            from ..obs.curves import trace_stride
+
+            tr_stride = trace_stride(epochs)
+            tr_used = -(-epochs // tr_stride)
+            tr0 = jnp.zeros((tr_used,), jnp.float32)
+        else:
+            tr_stride, tr0 = 1, None
+
+        def epoch_step(carry, xs):
+            p, vel, lr_t, t_samples, best, wait, tr = carry
+            ebatches, e_idx = xs
             (p, vel, _), losses = jax.lax.scan(
                 batch_step, (p, vel, lr_t), ebatches
             )
             epoch_loss = jnp.mean(losses)
+            if trace:
+                tr = tr.at[e_idx // tr_stride].set(epoch_loss)
             t_samples = t_samples + n
             if schedule == "invscaling":
                 lr_t = lr0 / (t_samples + 1.0) ** power_t
@@ -341,17 +402,24 @@ class _MLPBase(ModelKernel):
                 lr_t = jnp.where(cut, jnp.maximum(lr_t / 5.0, 1e-6), lr_t)
                 wait = jnp.where(cut, 0, wait)
                 best = jnp.minimum(best, epoch_loss)
-            return (p, vel, lr_t, t_samples, best, wait), None
+            return (p, vel, lr_t, t_samples, best, wait, tr), None
 
         vel0 = tmap(jnp.zeros_like, params)
-        (params, _, _, _, _, _), _ = jax.lax.scan(
+        (params, _, _, _, _, _, tr), _ = jax.lax.scan(
             epoch_step,
             (params, vel0, lr0 * jnp.asarray(1.0, jnp.float32),
              jnp.asarray(0.0, jnp.float32),
-             jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(0, jnp.int32)),
-            batches,
+             jnp.asarray(jnp.inf, jnp.float32), jnp.asarray(0, jnp.int32),
+             tr0),
+            (batches, jnp.arange(epochs, dtype=jnp.int32)),
         )
-        return params
+        if not trace:
+            return params, None
+        return params, {
+            "loss": tr,
+            "stride": jnp.asarray(float(tr_stride), jnp.float32),
+            "steps": jnp.asarray(float(epochs), jnp.float32),
+        }
 
 
     # ---- fused Pallas batched path (ops/pallas_mlp.py) -------------------

@@ -638,11 +638,26 @@ _HOST_EXEC_MACS = float(os.environ.get("CS230_HOST_EXEC_MACS", 2e8))
 
 
 def _make_batched(kernel, static, has_hyper):
+    from ..obs.curves import curves_enabled
+
+    # trial telemetry plane: kernels exposing fit_curve emit bounded
+    # in-scan traces as extra result leaves (curve_*) that ride the
+    # packed fetch / mesh sharding like any other output. The decision is
+    # baked at trace time; kernel.trace_salt() carries the valve, so
+    # every executable cache re-keys when it flips.
+    capture = curves_enabled() and hasattr(kernel, "fit_curve")
+
     def scores_for_trial(X, y, TW, EW, hyper):
         if not has_hyper:
             hyper = {}
 
         def one_split(tw, ew):
+            if capture:
+                fitted, curve = kernel.fit_curve(X, y, tw, hyper, static)
+                out = dict(kernel.evaluate(fitted, X, y, ew, static))
+                for k, v in curve.items():
+                    out["curve_" + k] = v
+                return out
             fitted = kernel.fit(X, y, tw, hyper, static)
             return kernel.evaluate(fitted, X, y, ew, static)
 
@@ -1556,6 +1571,11 @@ def _get_compiled(kernel, static_key, static, mesh, trial_axis, data, plan, chun
     )
     cache_key = (
         kernel.name,
+        # trace-time env knobs (fused-step/curves/... valves) change the
+        # traced program without landing in static — without the salt a
+        # mid-process valve flip would serve a stale executable from this
+        # in-memory cache (the disk _aot_key below already carries it)
+        kernel.trace_salt(),
         tuple(sorted((k, str(v)) for k, v in static.items())),
         data.X.shape,
         x_sig,
@@ -1649,6 +1669,16 @@ def _run_chunked(
     """
     n_chunks = int(chunk_plan["n_chunks"])
     n_dev = int(np.prod(list(mesh.shape.values()))) if mesh is not None else 1
+
+    from ..obs.curves import curve_points, curves_enabled
+
+    # sampled-chunk curve stride; 0 = capture off (single-chunk plans
+    # have no intermediate prefix to evaluate)
+    curve_stride = (
+        max(1, -(-n_chunks // curve_points()))
+        if curves_enabled() and n_chunks > 1 and not warm_only
+        else 0
+    )
 
     def _h(hyper):
         return hyper if hyper_names else {}
@@ -1815,11 +1845,28 @@ def _run_chunked(
 
         t0 = time.perf_counter()
         group_outs = []
+        group_curves = []
         for twg, ewg, size in split_groups:
             state = fi(X, y, twg, ewg, hyper_arg)
+            mids = []
             for ci in range(n_chunks):
                 state = fs(X, y, twg, ewg, hyper_arg, jnp.int32(ci), state)
+                if (
+                    curve_stride
+                    and (ci + 1) % curve_stride == 0
+                    and ci < n_chunks - 1
+                ):
+                    # trial telemetry plane: score-vs-chunk curve via
+                    # strided extra eval dispatches on the existing fe
+                    # executable (the accumulator protocol makes every
+                    # prefix a valid model) — the tree kernels themselves
+                    # are untouched. eval is O(n*k) against the chunk's
+                    # O(n*k*trees) build, so the sampled extra evals stay
+                    # inside the curve overhead gate.
+                    mids.append(fe(X, y, twg, ewg, hyper_arg, state))
             group_outs.append((fe(X, y, twg, ewg, hyper_arg, state), size))
+            group_curves.append(mids)
+            dispatches += len(mids)
         if mesh is not None and len(split_groups) == 1:
             # collective argmax on the trial-sharded eval output (see
             # run_trials' generic path); split-group runs skip it — their
@@ -1842,10 +1889,32 @@ def _run_chunked(
             result_bytes += nb
             fetched.append((host, size))
         group_outs = fetched
+        mids_host = []
+        for mids in group_curves:
+            row = []
+            for og in mids:
+                host, nf, nb = _fetch_result(og, fe_spec)
+                n_fetches += nf
+                result_bytes += nb
+                row.append(host)
+            mids_host.append(row)
         out = {
             k: np.concatenate([og[k][:, :size] for og, size in group_outs], axis=1)
             for k in group_outs[0][0]
         }
+        if curve_stride:
+            cs = [
+                np.stack(
+                    [m["score"][:, :size] for m in row]
+                    + [host["score"][:, :size]],
+                    axis=-1,
+                )
+                for (host, size), row in zip(group_outs, mids_host)
+            ]
+            out["curve_score"] = np.concatenate(cs, axis=1)
+            shape2 = out["score"].shape[:2]
+            out["curve_stride"] = np.full(shape2, float(curve_stride), np.float32)
+            out["curve_steps"] = np.full(shape2, float(n_chunks), np.float32)
         run_time += time.perf_counter() - t0
         dispatches += (2 + n_chunks) * len(split_groups)
 
@@ -2006,4 +2075,19 @@ def _postprocess(out: Dict[str, np.ndarray], j: int, plan: SplitPlan, task: str,
     if not np.isfinite(metrics["mean_cv_score"]):
         metrics["mean_cv_score"] = float("-inf")
         metrics["diverged"] = True
+    channels = {
+        k[len("curve_"):]: out[k][j]
+        for k in out
+        if k.startswith("curve_") and k not in ("curve_stride", "curve_steps")
+    }
+    if channels:
+        from ..obs.curves import build_curve_record
+
+        # stride/steps ride as per-(trial, split) leaves purely so they
+        # share the score transport; they are bucket-constant
+        stride = int(np.asarray(out["curve_stride"])[j].flat[0])
+        steps = int(np.asarray(out["curve_steps"])[j].flat[0])
+        metrics["curve"] = build_curve_record(
+            channels, stride, steps, tail=np.asarray(out["score"][j]).reshape(-1)
+        )
     return metrics

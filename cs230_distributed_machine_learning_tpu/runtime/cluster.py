@@ -378,11 +378,12 @@ class ClusterRuntime:
                 attempt=int(result.get("attempt") or 0),
             )
         self.engine.record_outcome(worker_id, ok)
-        if result.get("status") in ("failed", "pruned"):
-            # failed attempts emit no metrics message, and a pruned
-            # attempt's release message may race the result: release the
-            # engine's books (queue entry, load, lease) here (idempotent —
-            # release_task no-ops once the books are clear)
+        if result.get("status") in ("failed", "pruned", "diverged"):
+            # failed attempts emit no metrics message, and a pruned (or
+            # watchdog-diverged) attempt's release message may race the
+            # result: release the engine's books (queue entry, load,
+            # lease) here (idempotent — release_task no-ops once the
+            # books are clear)
             self.engine.release_task(worker_id, result.get("subtask_id"))
         if result.get("status") in SUBTASK_TERMINAL_STATUSES:
             self.clear_cancels([result.get("subtask_id")])

@@ -31,6 +31,7 @@ from ..obs import (
     record_event,
     span,
 )
+from ..obs.curves import CurveStore, divergence
 from ..utils.config import FrameworkConfig, get_config
 from ..utils.logging import get_logger
 from ..utils.serialization import json_safe
@@ -121,6 +122,11 @@ class Coordinator:
         from ..obs.signals import CapacitySignals
         from ..obs.slo import AlertEngine, default_rules
 
+        # trial telemetry plane (docs/OBSERVABILITY.md "Trial telemetry
+        # plane"): bounded in-memory store of per-trial learning curves —
+        # fed by result/metrics ingest, read by GET /curves and the SSE
+        # stream, consulted by the numerical-health watchdog
+        self.curves = CurveStore()
         self.signals = CapacitySignals(self)
         self.alerts = AlertEngine(
             default_rules(self.config),
@@ -207,6 +213,16 @@ class Coordinator:
                 gauge_set(
                     "tpuml_mesh_devices_total", float(eng.total_devices())
                 )
+        # re-seed the curve store from journaled ``curve`` ops: rung-
+        # boundary curves survive a restart, so /curves and the watchdog's
+        # divergence history pick up where the dead coordinator left off
+        replayed_curves = self.store.drain_replayed_curves()
+        for e in replayed_curves:
+            self.curves.ingest(
+                e["jid"], e["stid"], e["curve"],
+                rung=e.get("rung", 0), attempt=e.get("attempt", 0),
+                diverged=bool(e.get("diverged")),
+            )
         resumed = self.resume_inflight()
         recovery_s = self.store.replay_seconds + (time.time() - t0)
         self.recovery = {
@@ -214,6 +230,7 @@ class Coordinator:
             "replay_skipped": self.store.replay_skipped,
             "jobs_resumed": len(resumed),
             "subtasks_requeued": self._resume_requeued,
+            "curves_replayed": len(replayed_curves),
             "recovery_seconds": recovery_s,
         }
         gauge_set("tpuml_coordinator_recovery_seconds", recovery_s)
@@ -727,13 +744,14 @@ class Coordinator:
         ):
             return out
         tomb = dict(self.store.steal_tombstones)
-        queued = [
-            stid
-            for q in self.cluster.engine.queue_snapshot().values()
+        snap = self.cluster.engine.worker_snapshot()
+        owner = {
+            stid: wid
+            for wid, q in self.cluster.engine.queue_snapshot().items()
             for stid in q[1:]
             if stid not in tomb
-        ]
-        info = self.store.lookup_specs(queued)
+        }
+        info = self.store.lookup_specs(list(owner))
         for stid, rec in info.items():
             spec = rec["spec"]
             if spec.get("asha"):
@@ -744,12 +762,21 @@ class Coordinator:
                     "job_id": rec["job_id"],
                     "session_id": rec["session_id"],
                     "est_s": spec.get("est_s"),
+                    # priced width: the mesh slice the donor's engine
+                    # packed this trial onto — a thief filters candidates
+                    # to what its own widest IDLE slice can serve
+                    # (heterogeneous fleets must not pull 8-device work
+                    # onto a 1-device shard)
+                    "n_devices": int(
+                        (snap.get(owner[stid]) or {}).get("n_devices") or 1
+                    ),
                 }
             )
         return out
 
     def release_for_steal(
-        self, thief_shard: int, max_n: int
+        self, thief_shard: int, max_n: int,
+        max_n_devices: Optional[int] = None, prefer_wide: bool = False,
     ) -> List[Dict[str, Any]]:
         """Donor grant (``POST /steal_tasks``): hand up to ``max_n``
         queued subtasks to a thief shard as FRESH ledger attempts. Each
@@ -757,7 +784,13 @@ class Coordinator:
         late FAILED is stale, its late COMPLETED still wins first),
         releases the engine book entry, and journals a ``steal``
         tombstone so neither a live nor a restarted donor re-dispatches
-        the subtask inside the steal lease."""
+        the subtask inside the steal lease.
+
+        Mesh-aware grants: ``max_n_devices`` (the thief's widest idle
+        slice) filters out candidates priced wider than the thief can
+        serve; ``prefer_wide`` grants the widest-priced candidates first
+        so wide trials land on wide slices. Both default to the legacy
+        width-blind behavior for old thieves."""
         if (
             self.cluster is None
             or not self.config.service.rebalance_enabled
@@ -765,15 +798,32 @@ class Coordinator:
         ):
             return []
         tomb = dict(self.store.steal_tombstones)
+        snap = self.cluster.engine.worker_snapshot()
         owner = {
             stid: wid
             for wid, q in self.cluster.engine.queue_snapshot().items()
             for stid in q[1:]
             if stid not in tomb
         }
+        width = {
+            stid: int((snap.get(wid) or {}).get("n_devices") or 1)
+            for stid, wid in owner.items()
+        }
+        if max_n_devices is not None:
+            owner = {
+                stid: wid for stid, wid in owner.items()
+                if width[stid] <= int(max_n_devices)
+            }
         info = self.store.lookup_specs(list(owner))
+        items = sorted(
+            info.items(),
+            key=(
+                (lambda kv: (-width.get(kv[0], 1), kv[0]))
+                if prefer_wide else (lambda kv: kv[0])
+            ),
+        )
         granted: List[Dict[str, Any]] = []
-        for stid, rec in info.items():
+        for stid, rec in items:
             if len(granted) >= int(max_n):
                 break
             if rec["spec"].get("asha"):
@@ -795,6 +845,7 @@ class Coordinator:
                 "steal.out", job_id=rec["job_id"], subtask_id=stid,
                 attempt=int(task.get("attempt") or 0),
                 thief_shard=int(thief_shard),
+                n_devices=width.get(stid, 1),
             )
         if granted:
             logger.info(
@@ -808,10 +859,31 @@ class Coordinator:
         hottest offering shard, run the grants on the local fabric, and
         relay every result back to the donor's ``/peer_result`` (the
         donor's still-running ingest loop counts them — its ledger
-        expects exactly the granted attempt)."""
+        expects exactly the granted attempt).
+
+        Mesh-aware: candidates are priced with the device width of the
+        slice the donor packed them onto, and this thief only pulls work
+        its widest IDLE slice can serve — preferring the widest-priced
+        candidates so wide trials land on wide slices instead of
+        serializing on whatever narrow worker is free."""
         import requests
 
         svc = self.config.service
+        # widest idle local slice: the upper bound on the candidate width
+        # this shard can usefully absorb right now
+        widest_idle = 0
+        try:
+            snap = self.cluster.engine.worker_snapshot()
+            for wid, q in self.cluster.engine.queue_snapshot().items():
+                if not q:
+                    widest_idle = max(
+                        widest_idle,
+                        int((snap.get(wid) or {}).get("n_devices") or 1),
+                    )
+        except Exception:  # noqa: BLE001 — a torn snapshot must not crash the sweep
+            widest_idle = 0
+        if widest_idle <= 0:
+            return  # no idle slice: stolen work would only queue here
         offers: Dict[int, Dict[str, Any]] = {}
         for k, url in enumerate(self.peer_urls):
             if k == self.shard_id or not url:
@@ -820,7 +892,12 @@ class Coordinator:
                 r = requests.get(f"{url}/steal_candidates", timeout=3)
                 if r.ok:
                     body = r.json() or {}
-                    if body.get("candidates"):
+                    servable = [
+                        c for c in (body.get("candidates") or [])
+                        if int(c.get("n_devices") or 1) <= widest_idle
+                    ]
+                    if servable:
+                        body["candidates"] = servable
                         offers[k] = body
             except (requests.RequestException, ValueError):
                 continue
@@ -836,6 +913,8 @@ class Coordinator:
                 json={
                     "thief_shard": self.shard_id,
                     "max_n": int(svc.steal_max_tasks),
+                    "max_n_devices": widest_idle,
+                    "prefer_wide": widest_idle > 1,
                 },
                 timeout=10,
             )
@@ -959,6 +1038,82 @@ class Coordinator:
             return
         counter_inc("tpuml_peer_results_ingested_total")
         self.bus.publish(TOPIC_RESULTS, result, key=stid)
+
+    # ------------- trial telemetry plane (docs/OBSERVABILITY.md) -------------
+
+    def ingest_curve(
+        self, sid: str, job_id: str, subtask_id: str, curve: Dict[str, Any],
+        *, rung: int = 0, attempt: int = 0,
+    ) -> bool:
+        """Ingest one trial's learning-curve record into the curve store
+        and return the watchdog's divergence verdict. The store dedups on
+        (subtask, rung, attempt) — the same curve arriving over both the
+        metrics and the result transport counts, journals, and events
+        exactly once. The divergence verdict is recomputed either way:
+        the CALLER decides whether it terminates the trial (search loops
+        do; plain jobs only mark the curve)."""
+        if not isinstance(curve, dict) or not subtask_id:
+            return False
+        diverged = divergence(
+            curve, self.config.service.curve_divergence_factor
+        )
+        added = self.curves.ingest(
+            job_id, subtask_id, curve,
+            rung=rung, attempt=attempt, diverged=diverged,
+        )
+        if added:
+            counter_inc("tpuml_curve_points_total", float(added))
+            record_event(
+                "curve.ingest", job_id=job_id, subtask_id=subtask_id,
+                rung=int(rung or 0), attempt=int(attempt or 0),
+                n_points=added, diverged=diverged,
+            )
+            try:
+                # journal so a restarted coordinator replays /curves and
+                # the divergence history (torn tails are skipped by the
+                # store's line-checksum replay)
+                self.store.record_curve(
+                    sid, job_id, subtask_id, curve,
+                    rung=rung, attempt=attempt, diverged=diverged,
+                )
+            except KeyError:
+                pass  # foreign/evicted job: serve from memory only
+        return diverged
+
+    def job_curves(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """All recorded learning curves for a job (``GET /curves/<jid>``),
+        joined with the job's live status. None when the job id is
+        unknown; a known job with no curves yet (CS230_CURVES=0, or no
+        rung has reported) returns an empty ``curves`` list."""
+        sid = next(
+            (
+                j["session_id"]
+                for j in self.store.jobs_overview()
+                if j["job_id"] == job_id
+            ),
+            None,
+        )
+        if sid is None:
+            return None
+        progress = self.store.job_progress(sid, job_id)
+        out = self.curves.job(job_id) or {
+            "job_id": job_id, "n_curves": 0, "curves": []
+        }
+        out["job_status"] = progress.get("job_status")
+        out["tasks_diverged"] = progress.get("tasks_diverged", 0)
+        return out
+
+    def subtask_curves(self, job_id: str, subtask_id: str) -> Dict[str, Any]:
+        """One trial's curve history across rungs/attempts
+        (``GET /curves/<jid>/<stid>``). Raises KeyError when the pair
+        never reported a curve — the route's 404."""
+        out = self.curves.subtask(job_id, subtask_id)
+        if out is None:
+            raise KeyError(
+                f"no curves recorded for subtask {subtask_id!r} of job "
+                f"{job_id!r}"
+            )
+        return out
 
     # ------------- admission control (docs/ROBUSTNESS.md "Overload") -------------
 
@@ -1245,6 +1400,15 @@ class Coordinator:
         def on_result(subtask_id: str, status: str, result: Optional[Dict[str, Any]]):
             self.store.update_subtask(sid, job_id, subtask_id, status, result)
             r = result or {}
+            if isinstance(r.get("curve"), dict):
+                # terminal curve ingest (deduped against the metrics-path
+                # delivery): verdict only — termination decisions belong
+                # to the search loops, and this result is terminal anyway
+                self.ingest_curve(
+                    sid, job_id, subtask_id, r["curve"],
+                    rung=int((r.get("asha") or {}).get("rung") or 0),
+                    attempt=int(r.get("attempt") or 0),
+                )
             record_event(
                 "result", job_id=job_id, subtask_id=subtask_id,
                 worker_id=r.get("worker_id"),
@@ -1255,6 +1419,14 @@ class Coordinator:
             self.bus.publish(TOPIC_RESULTS, result, key=subtask_id)
 
         def on_metrics(msg: Dict[str, Any]):
+            if isinstance(msg.get("curve"), dict):
+                # live curve ingest: the trace reaches /curves and the SSE
+                # stream at the batch boundary, before the result settles
+                self.ingest_curve(
+                    sid, job_id, msg.get("subtask_id"), msg["curve"],
+                    rung=int(msg.get("rung") or 0),
+                    attempt=int(msg.get("attempt") or 0),
+                )
             self.bus.publish(TOPIC_METRICS, msg, key=msg.get("subtask_id"))
 
         def on_intermediate(subtask_id: str, result: Optional[Dict[str, Any]]):
@@ -1267,6 +1439,14 @@ class Coordinator:
                 sid, job_id, subtask_id, "promoted", result
             )
             r = result or {}
+            if isinstance(r.get("curve"), dict):
+                # rung-boundary curve of a promoted trial — journaled here
+                # so a replayed coordinator has each rung's trace
+                self.ingest_curve(
+                    sid, job_id, subtask_id, r["curve"],
+                    rung=int((r.get("asha") or {}).get("rung") or 0),
+                    attempt=int(r.get("attempt") or 0),
+                )
             record_event(
                 "result", job_id=job_id, subtask_id=subtask_id,
                 worker_id=r.get("worker_id"),
@@ -1687,8 +1867,22 @@ class Coordinator:
                     # a rung report (completed) or a cooperative-cancel
                     # terminal (pruned) — both feed the controller; the
                     # driver dedups duplicate/stale deliveries itself
+                    curve = result.get("curve")
                     if status == "pruned":
                         step = driver.handle_pruned_result(stid, result)
+                    elif isinstance(curve, dict) and self.ingest_curve(
+                        sid, job_id, stid, curve,
+                        rung=int((result.get("asha") or {}).get("rung") or 0),
+                        attempt=int(result.get("attempt") or 0),
+                    ):
+                        # numerical-health watchdog: the rung's trace is
+                        # non-finite or blowing up — terminate the trial
+                        # as ``diverged`` (never a failure: no retry
+                        # budget burns, no quarantine) instead of letting
+                        # the ladder promote it
+                        step = driver.handle_diverged(
+                            stid, curve, result=result
+                        )
                     else:
                         step = driver.handle_result(stid, result)
                     self._apply_search_step(
@@ -1831,6 +2025,27 @@ class Coordinator:
             steps: List[Step] = []
 
             def _metrics(msg):
+                curve = msg.get("curve")
+                stid_m = msg.get("subtask_id")
+                if isinstance(curve, dict) and stid_m:
+                    # numerical-health watchdog, metrics path: the trace
+                    # arrives at the batch boundary while sibling groups
+                    # of the wave may still be running — a diverged trial
+                    # is terminated NOW (cooperative cancel reaches the
+                    # executor before its next batch boundary) instead of
+                    # burning the rest of its rung budget
+                    if self.ingest_curve(
+                        sid, job_id, stid_m, curve,
+                        rung=int(msg.get("rung") or 0),
+                        attempt=int(msg.get("attempt") or 0),
+                    ):
+                        dstep = driver.handle_diverged(
+                            stid_m, curve, result=None
+                        )
+                        if dstep.cancels:
+                            self.executor.cancel(dstep.cancels)
+                        if dstep.finished or dstep.new_tasks or dstep.promoted:
+                            steps.append(dstep)
                 step = driver.handle_metrics(msg)
                 if step.cancels:
                     # reach the executor before its next batch boundary
@@ -1876,6 +2091,7 @@ class Coordinator:
         completed = [r for r in results if r and r.get("status") == "completed"]
         failed = [r for r in results if r and r.get("status") == "failed"]
         pruned = [r for r in results if r and r.get("status") == "pruned"]
+        diverged = [r for r in results if r and r.get("status") == "diverged"]
 
         def score_key(r):
             # None survives JSON round-trips from remote agents (inf/NaN are
@@ -1934,6 +2150,13 @@ class Coordinator:
             final["n_pruned"] = len(pruned)
             if search_summary is not None:
                 final["search"] = search_summary
+        if diverged:
+            # watchdog terminations (docs/OBSERVABILITY.md "Trial
+            # telemetry plane"): numerically-unhealthy trials are their
+            # own NON-failure report — like pruned, they never count
+            # against retry budgets or quarantine
+            final["diverged_results"] = diverged
+            final["n_diverged"] = len(diverged)
         # quarantine contract (docs/ROBUSTNESS.md): subtasks the retry
         # layer gave up on surface as a structured report, and the job
         # finalizes as ``completed_with_failures`` (partial results)
@@ -1978,10 +2201,21 @@ class Coordinator:
 
     def stream_status(self, sid: str, job_id: str, tick_s: Optional[float] = None):
         """Generator yielding progress dicts until completion — the SSE body
-        (master.py:237-266 semantics, 1.5 s default tick)."""
+        (master.py:237-266 semantics, 1.5 s default tick). Between progress
+        snapshots, freshly-ingested learning curves are interleaved as
+        ``{"kind": "curve", ...}`` events (incremental: the store's version
+        counter is the cursor, so each curve streams exactly once). The
+        progress snapshot is read BEFORE the curve drain: a terminal
+        status implies aggregation finished, so every curve ingested
+        before it is already behind the cursor and flushes on this final
+        iteration — nothing is lost to the return."""
         tick = tick_s if tick_s is not None else self.config.service.sse_tick_s
+        since = 0
         while True:
             progress = self.store.job_progress(sid, job_id)
+            fresh, since = self.curves.updates(job_id, since)
+            for entry in fresh:
+                yield {"kind": "curve", "job_id": job_id, **entry}
             yield progress
             if progress["job_status"] in TERMINAL_STATUSES:
                 return

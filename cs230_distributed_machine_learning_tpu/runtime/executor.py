@@ -505,6 +505,7 @@ class LocalExecutor:
                         batch_size=len(idxs), primary=(j == 0),
                         batch_cost=batch_cost,
                         score=run.trial_metrics[j].get("mean_cv_score"),
+                        curve=run.trial_metrics[j].get("curve"),
                     )
                 )
 
@@ -700,7 +701,8 @@ class LocalExecutor:
 
     def _metrics_message(self, st, received_at, started_at, finished_at,
                          algo, resources=None, run=None, batch_size=1,
-                         primary=False, batch_cost=None, score=None):
+                         primary=False, batch_cost=None, score=None,
+                         curve=None):
         """Reference metrics schema (worker.py:233-243): CPU/mem averaged
         over the fit by the 0.5 s-cadence ResourceSampler (the predictor's
         feature inputs), plus device peak-memory — the accelerator signal
@@ -770,6 +772,14 @@ class LocalExecutor:
             msg["batch_bytes_accessed"] = batch_cost.get("bytes_accessed")
             msg["batch_mfu"] = batch_cost.get("mfu")
             msg["batch_hbm_peak_bytes"] = batch_cost.get("hbm_peak_bytes")
+        if curve is not None:
+            # trial telemetry plane: the per-trial convergence trace rides
+            # the metrics message so the coordinator can ingest (and
+            # watchdog) it live, before the result settles. Per-SUBTASK —
+            # no batch dedup needed; the curve store dedups re-delivery
+            # through the result transport on (subtask, rung, attempt).
+            msg["curve"] = curve
+            msg["attempt"] = int(st.get("attempt") or 0)
         return msg
 
 

@@ -74,7 +74,7 @@ _WORKER_ROUTES = {
 }
 #: routed by the job-id stamp (scatter probe for unstamped ids); "trace"
 #: also covers /trace/<jid>/export — the stamp is still parts[1]
-_JOB_ROUTES = {"trace", "cost", "explain", "critical_path"}
+_JOB_ROUTES = {"trace", "cost", "explain", "critical_path", "curves"}
 #: response headers forwarded from the shard to the client
 _FWD_HEADERS = (
     "Content-Type", "Retry-After", "X-Trace-Id", "X-Dataset-Kind",

@@ -376,7 +376,7 @@ class AshaController:
             return []
         self.decided[trial_id] = outcome
         k = self.trial_rung[trial_id]
-        if outcome in ("pruned", "failed"):
+        if outcome in ("pruned", "failed", "diverged"):
             self.rungs[k].removed.add(trial_id)
             self.rungs[k].reported.pop(trial_id, None)
         decisions: List[Dict[str, Any]] = []
@@ -422,6 +422,11 @@ class AshaController:
             ),
             "pruned": sum(1 for v in self.decided.values() if v == "pruned"),
             "failed": sum(1 for v in self.decided.values() if v == "failed"),
+            # numerical-health watchdog terminals (docs/OBSERVABILITY.md
+            # "Trial telemetry plane") — non-failure, like pruned
+            "diverged": sum(
+                1 for v in self.decided.values() if v == "diverged"
+            ),
             "n_trials": len(self.trial_rung),
         }
 
@@ -485,6 +490,7 @@ class MultiBracketController:
             "completed": sum(s["completed"] for s in per),
             "pruned": sum(s["pruned"] for s in per),
             "failed": sum(s["failed"] for s in per),
+            "diverged": sum(s.get("diverged", 0) for s in per),
             "n_trials": sum(s["n_trials"] for s in per),
         }
 
@@ -773,6 +779,7 @@ class SearchJobDriver:
             # a completed result with no usable score cannot climb the
             # ladder — treat it like a terminal execution failure
             return self.handle_quarantine(stid, result)
+        score = self._curve_adjusted_score(result, float(score))
         tt = result.get("training_time")
         resource = int(a.get("resource", 0))
         self._last_result[stid] = result
@@ -846,6 +853,109 @@ class SearchJobDriver:
         if stid not in self._finalized:
             self._finalized.add(stid)
             step.finished.append((stid, "pruned", result))
+        return step
+
+    def _curve_adjusted_score(
+        self, result: Dict[str, Any], score: float
+    ) -> float:
+        """Curve-aware rung decisions (docs/SEARCH.md), opt-in via
+        ``CS230_ASHA_CURVE=1``: tilt the reported score by the learning
+        curve's last-k slope so a still-improving trial outranks a
+        plateaued peer with the same boundary score. The tilt is bounded
+        (±5% of |score|) and ADDITIVE, so ranking stays stable and the
+        adjusted value is what gets journaled — replay re-feeds the same
+        number and reproduces the same promotions."""
+        import os
+
+        if os.environ.get("CS230_ASHA_CURVE") != "1":
+            return score
+        curve = result.get("curve")
+        if not isinstance(curve, dict):
+            return score
+        from ..obs.curves import last_k_slope
+
+        rows, sign = None, 1.0
+        if isinstance(curve.get("loss"), list) and curve["loss"]:
+            rows, sign = curve["loss"], -1.0  # falling loss = improving
+        elif isinstance(curve.get("score"), list) and curve["score"]:
+            rows, sign = curve["score"], 1.0
+        if not rows:
+            return score
+        tilts = []
+        for row in rows:
+            slope = last_k_slope(row)
+            if slope is None:
+                continue
+            finite = [v for v in row if isinstance(v, (int, float))]
+            ref = max(abs(finite[-1]), 1e-12) if finite else 1.0
+            tilts.append(sign * slope / ref)
+        if not tilts:
+            return score
+        tilt = max(-0.05, min(0.05, sum(tilts) / len(tilts)))
+        return score + abs(score) * tilt
+
+    def handle_diverged(
+        self,
+        stid: str,
+        curve: Dict[str, Any],
+        result: Optional[Dict[str, Any]] = None,
+    ) -> Step:
+        """Numerical-health watchdog verdict (docs/OBSERVABILITY.md
+        "Trial telemetry plane"): the trial's learning curve went
+        non-finite, or its tail blew past ``curve_divergence_factor`` ×
+        its early-trace median. The trial leaves the ladder under the
+        NON-failure terminal ``diverged`` — never quarantine, numerics
+        (a bad hyperparameter draw) killed it, not infrastructure — and
+        never climbs to a higher rung, which is where the device-second
+        savings come from. ``result`` is the delivering rung result when
+        the curve rode a completed result (nothing left to cancel);
+        None when it rode the early metrics feed, in which case the
+        attempt is still burning budget and gets a cooperative cancel
+        (PR-12 path: the executor drops it at the next batch boundary)."""
+        if stid in self._finalized or stid not in self.specs:
+            return Step()
+        rung = int(self.controller.trial_rung.get(stid, 0))
+        if result is not None:
+            self._last_result[stid] = dict(result)
+            a0 = dict(result.get("asha") or {})
+            tt = result.get("training_time")
+            resource = int(a0.get("resource", 0) or 0)
+            if isinstance(tt, (int, float)) and resource > 0:
+                self._last_time[stid] = (float(tt), resource)
+                if (stid, rung) not in self._counted:
+                    self._counted.add((stid, rung))
+                    self._spent[stid] = self._spent.get(stid, 0) + resource
+        decisions = self.controller.force_decide(stid, "diverged")
+        step = self._apply(decisions, reporting=None)
+        self._finalized.add(stid)
+        counter_inc("tpuml_trials_diverged_total")
+        saved = self._device_seconds_saved(stid)
+        if saved is not None and saved > 0:
+            counter_inc(
+                "tpuml_device_seconds_saved_total", saved, reason="diverge"
+            )
+        record_event(
+            "trial.diverge", job_id=self.job_id, subtask_id=stid,
+            rung=rung, nonfinite=bool((curve or {}).get("nonfinite")),
+            device_seconds_saved=round(saved, 6) if saved else None,
+        )
+        if result is None:
+            spec = self.specs[stid]
+            attempt = int(spec.get("attempt") or 0)
+            counter_inc("tpuml_trials_cancelled_total")
+            record_event(
+                "trial.cancel", job_id=self.job_id, subtask_id=stid,
+                attempt=attempt, rung=rung, reason="diverged",
+            )
+            step.cancels.append(
+                {"subtask_id": stid, "attempt": attempt,
+                 "job_id": self.job_id}
+            )
+        res = self._synth_result(
+            stid, "diverged",
+            {"rung": rung, "reason": "diverged", "score": None},
+        )
+        step.finished.append((stid, "diverged", res))
         return step
 
     def handle_quarantine(self, stid: str, result: Dict[str, Any]) -> Step:
@@ -935,7 +1045,9 @@ class SearchJobDriver:
         counter_inc("tpuml_trials_pruned_total")
         saved = self._device_seconds_saved(tid)
         if saved is not None and saved > 0:
-            counter_inc("tpuml_device_seconds_saved_total", saved)
+            counter_inc(
+                "tpuml_device_seconds_saved_total", saved, reason="prune"
+            )
         record_event(
             "rung.prune", job_id=self.job_id, subtask_id=tid,
             rung=d["rung"], resource=d["resource"], score=d.get("score"),
@@ -1016,4 +1128,9 @@ class SearchJobDriver:
         if status == "pruned":
             base["pruned"] = True
             base["prune_reason"] = d.get("reason")
+        elif status == "diverged":
+            # watchdog terminal: flagged so the ranking/predictor paths
+            # can skip it (its last measured score is numerically suspect)
+            base["diverged"] = True
+            base["diverge_reason"] = d.get("reason", "diverged")
         return base

@@ -58,10 +58,12 @@ _DASHBOARD_HTML = """<!doctype html>
  <code>/metrics/prom</code> <code>/metrics/history?name=</code> <code>/trace/&lt;job_id&gt;</code>
  <code>/critical_path/&lt;job_id&gt;</code> <code>/trace/&lt;job_id&gt;/export</code>
  <code>/cost/&lt;job_id&gt;</code> <code>/explain/&lt;job_id&gt;/&lt;subtask_id&gt;</code>
- <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code>
+ <code>/curves/&lt;job_id&gt;</code> <code>/events</code> <code>/predictor/calibration</code> <code>/healthz</code>
  <code>/alerts</code> <code>/autoscale</code></div>
 <h2>Jobs</h2><table id="jobs"><thead><tr><th>job</th><th>model</th><th>dataset</th>
-<th>status</th><th>done</th><th>failed</th><th>pruned</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
+<th>status</th><th>done</th><th>failed</th><th>pruned</th><th>diverged</th><th>total</th><th>session</th></tr></thead><tbody></tbody></table>
+<h2>Learning curves (latest job)</h2>
+<div id="curves" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no curves yet</div>
 <h2>Latest job trace</h2>
 <div id="trace" style="background:#fff;border:1px solid #ddd;padding:8px;font-size:12px">no trace yet</div>
 <h2>Critical path</h2>
@@ -254,6 +256,28 @@ async function renderSparks(el, sparks){
   const html = blocks.filter(Boolean).join("");
   el.innerHTML = html || "no samples yet";
 }
+// learning-curve panel (GET /curves/<job_id> — docs/OBSERVABILITY.md
+// "Trial telemetry plane"): one sparkline per trial curve, drawn from
+// the record's primary channel (loss > score > gmax), split 0. Diverged
+// trials are flagged; None points (non-finite on device) are skipped.
+function renderCurves(el, c){
+  if (!c || !c.curves || !c.curves.length){ el.textContent = "no curves yet"; return; }
+  el.innerHTML =
+    `<div style="color:#666">job <code>${esc(c.job_id)}</code> · ` +
+    `${c.n_curves} curves · ${c.tasks_diverged || 0} diverged</div>` +
+    c.curves.slice(-10).map(e => {
+      const rec = e.curve || {};
+      const ch = rec.loss ? "loss" : (rec.score ? "score" : "gmax");
+      const row = ((rec[ch] || [])[0] || []);
+      const pts = row.map((v, i) => [i, v]).filter(p => p[1] != null && isFinite(p[1]));
+      const tail = (rec.tail || [])[0];
+      return `<div style="margin:2px 0;white-space:nowrap">` +
+        `<code>${esc(e.subtask_id)}</code> r${esc(e.rung)} ` +
+        sparkSvg(pts) + ` <b>${esc(ch)}</b>` +
+        (tail == null ? "" : ` tail <code>${(+tail).toPrecision(3)}</code>`) +
+        (e.diverged ? ` <span class="bad">diverged</span>` : "") + `</div>`;
+    }).join("");
+}
 // fleet health panel (docs/OBSERVABILITY.md "Fleet health plane"):
 // the derived capacity signals + per-rule alert states
 function renderHealth(scaleEl, alertsEl, sc, al){
@@ -305,8 +329,9 @@ async function tick(){
     <td class="${j.status === "completed" ? "ok" : (j.status === "failed" || j.status === "completed_with_failures") ? "bad" : ""}">${esc(j.status)}</td>
     <td>${esc(j.completed_subtasks)}</td><td>${esc(j.failed_subtasks)}</td>
     <td>${esc(j.pruned_subtasks || 0)}</td>
+    <td class="${j.diverged_subtasks ? "bad" : ""}">${esc(j.diverged_subtasks || 0)}</td>
     <td>${esc(j.total_subtasks)}</td><td>${esc((j.session_id || "").slice(0, 8))}</td></tr>`).join("")
-    || "<tr><td colspan=9>no jobs yet</td></tr>";
+    || "<tr><td colspan=10>no jobs yet</td></tr>";
   kvTable(document.getElementById("workers"), workers);
   kvTable(document.getElementById("queues"), queues);
   listTable(document.getElementById("sup"), sup);
@@ -320,6 +345,8 @@ async function tick(){
               latest ? await get(`/trace/${latest}`) : null);
   renderCritPath(document.getElementById("critpath"),
                  latest ? await get(`/critical_path/${latest}`) : null);
+  renderCurves(document.getElementById("curves"),
+               latest ? await get(`/curves/${latest}`) : null);
   renderCost(document.getElementById("cost"),
              latest ? await get(`/cost/${latest}`) : null);
   document.getElementById("ts").textContent = new Date().toLocaleTimeString();
@@ -388,6 +415,12 @@ def create_app(coordinator: Optional[Coordinator] = None):
             # metrics time-series history
             Rule("/explain/<jid>/<stid>", endpoint="explain", methods=["GET"]),
             Rule("/explain/<jid>", endpoint="explain_job", methods=["GET"]),
+            # trial telemetry plane (docs/OBSERVABILITY.md "Trial
+            # telemetry plane"): per-trial learning curves captured
+            # in-fit, plus the numerical-health watchdog's verdicts
+            Rule("/curves/<jid>", endpoint="curves_job", methods=["GET"]),
+            Rule("/curves/<jid>/<stid>", endpoint="curves_subtask",
+                 methods=["GET"]),
             Rule("/events", endpoint="events", methods=["GET"]),
             # fleet health plane (docs/OBSERVABILITY.md "Fleet health
             # plane"): SLO alert states and the derived capacity signals
@@ -465,6 +498,7 @@ def create_app(coordinator: Optional[Coordinator] = None):
                     "GET  /critical_path/<job_id>[?compare=<job_id>]",
                     "GET  /cost/<job_id>  (device cost report)",
                     "GET  /explain/<job_id>/<subtask_id>  (decision timeline)",
+                    "GET  /curves/<job_id>[/<subtask_id>]  (learning curves)",
                     "GET  /events?since=&limit=  (flight-recorder firehose)",
                     "GET  /predictor/calibration  (predicted-vs-actual stats)",
                     "GET  /health",
@@ -861,6 +895,37 @@ def create_app(coordinator: Optional[Coordinator] = None):
             )
         return _json({"job_id": jid, "subtask_ids": stids})
 
+    def curves_job(request, jid):
+        """All recorded learning curves for a job (docs/OBSERVABILITY.md
+        "Trial telemetry plane"): one entry per (trial, rung, attempt)
+        with the downsampled per-split trace and the watchdog's diverged
+        flag. 404 for an unknown job; a known job with no curves yet
+        returns an empty list."""
+        jid = coord.canonical_job_id(jid)
+        moved = _moved(jid)
+        if moved is not None:
+            return moved
+        out = coord.job_curves(jid)
+        if out is None:
+            return _json(
+                {"status": "error", "message": f"no job {jid!r}"}, status=404
+            )
+        return _json(out)
+
+    def curves_subtask(request, jid, stid):
+        """One trial's curve history across rungs/attempts — 404 when the
+        pair never reported a curve (CS230_CURVES=0, or evicted)."""
+        jid = coord.canonical_job_id(jid)
+        moved = _moved(jid)
+        if moved is not None:
+            return moved
+        try:
+            return _json(coord.subtask_curves(jid, stid))
+        except KeyError as e:
+            return _json(
+                {"status": "error", "message": str(e).strip("'")}, status=404
+            )
+
     def events(request):
         """Flight-recorder firehose: events with seq > ?since= (oldest
         first, at most ?limit=). ``last_seq`` is the cursor for the next
@@ -1212,11 +1277,21 @@ def create_app(coordinator: Optional[Coordinator] = None):
         try:
             thief = int(body.get("thief_shard", -1))
             max_n = int(body.get("max_n", coord.config.service.steal_max_tasks))
+            # mesh-aware stealing (optional, backward-compatible): the
+            # thief's widest idle slice caps the priced candidate width
+            max_nd = body.get("max_n_devices")
+            max_nd = int(max_nd) if max_nd is not None else None
         except (TypeError, ValueError):
             from werkzeug.exceptions import BadRequest
 
-            raise BadRequest("thief_shard and max_n must be integers")
-        return _json({"tasks": coord.release_for_steal(thief, max_n)})
+            raise BadRequest(
+                "thief_shard, max_n and max_n_devices must be integers"
+            )
+        return _json({"tasks": coord.release_for_steal(
+            thief, max_n,
+            max_n_devices=max_nd,
+            prefer_wide=bool(body.get("prefer_wide")),
+        )})
 
     def peer_result(request):
         """Result relay from a peer shard: forwarded late results from a

@@ -35,8 +35,12 @@ TERMINAL_STATUSES = ("completed", "failed", "completed_with_failures")
 #: per-SUBTASK terminal statuses. ``pruned`` is the adaptive-search
 #: contract (docs/SEARCH.md): a non-failure terminal state for a trial the
 #: rung controller stopped early — it counts toward job completion like
-#: ``completed`` but never toward the failure report.
-SUBTASK_TERMINAL_STATUSES = ("completed", "failed", "pruned")
+#: ``completed`` but never toward the failure report. ``diverged`` is the
+#: numerical-health watchdog's verdict (docs/OBSERVABILITY.md "Trial
+#: telemetry plane"): the trial's learning curve went non-finite or blew
+#: past the divergence threshold — terminal like ``pruned``, never a
+#: failure and never quarantine.
+SUBTASK_TERMINAL_STATUSES = ("completed", "failed", "pruned", "diverged")
 
 
 def _final_status(result) -> str:
@@ -80,6 +84,10 @@ class JobStore:
         #: cleared by the subtask's next result (any status) or reclaimed
         #: after ``steal_lease_s`` if the thief went dark.
         self.steal_tombstones: Dict[str, Dict[str, Any]] = {}
+        #: ``curve`` journal entries seen during replay, drained once by
+        #: the coordinator into its CurveStore (trial telemetry plane,
+        #: docs/OBSERVABILITY.md) so /curves history survives a restart
+        self._replayed_curves: List[Dict[str, Any]] = []
         if journal_dir:
             os.makedirs(journal_dir, exist_ok=True)
             self._journal_path = os.path.join(journal_dir, "jobs.jsonl")
@@ -146,6 +154,9 @@ class JobStore:
                             "completed_subtasks": job.get("completed_subtasks"),
                             "failed_subtasks": job.get("failed_subtasks"),
                             "pruned_subtasks": job.get("pruned_subtasks", 0),
+                            "diverged_subtasks": job.get(
+                                "diverged_subtasks", 0
+                            ),
                             "created_at": job.get("created_at"),
                             "completion_time": job.get("completion_time"),
                             # rebalancing provenance: where the job went
@@ -202,6 +213,7 @@ class JobStore:
             "completed_subtasks": 0,
             "failed_subtasks": 0,
             "pruned_subtasks": 0,
+            "diverged_subtasks": 0,
             "status": "pending",
             "subtasks": {
                 st["subtask_id"]: {"spec": json_safe(st), "status": "pending", "result": None}
@@ -275,12 +287,17 @@ class JobStore:
                 job["completed_subtasks"] += 1
             elif status == "pruned":
                 job["pruned_subtasks"] = job.get("pruned_subtasks", 0) + 1
+            elif status == "diverged":
+                job["diverged_subtasks"] = (
+                    job.get("diverged_subtasks", 0) + 1
+                )
             else:
                 job["failed_subtasks"] += 1
         done = (
             job["completed_subtasks"]
             + job["failed_subtasks"]
             + job.get("pruned_subtasks", 0)
+            + job.get("diverged_subtasks", 0)
         )
         total = job["total_subtasks"]
         if done < total:
@@ -349,6 +366,44 @@ class JobStore:
                 "lease_deadline": lease_deadline,
             }
         )
+
+    def record_curve(
+        self,
+        sid: str,
+        job_id: str,
+        subtask_id: str,
+        curve: Dict[str, Any],
+        rung: int = 0,
+        attempt: int = 0,
+        diverged: bool = False,
+    ) -> None:
+        """Journal a rung-boundary learning curve (docs/OBSERVABILITY.md
+        "Trial telemetry plane"). The coordinator's CurveStore is
+        in-memory only; journaling each ingested curve lets a restarted
+        coordinator re-serve ``GET /curves`` history instead of starting
+        blank. Replayed entries land in ``replayed_curves`` for the
+        coordinator to drain at boot (``drain_replayed_curves``)."""
+        self._journal(
+            {
+                "op": "curve",
+                "sid": sid,
+                "jid": job_id,
+                "stid": subtask_id,
+                "rung": int(rung or 0),
+                "attempt": int(attempt or 0),
+                "diverged": bool(diverged),
+                "curve": json_safe(curve),
+            }
+        )
+
+    def drain_replayed_curves(self) -> List[Dict[str, Any]]:
+        """Hand replayed ``curve`` entries to the caller exactly once —
+        the boot-time bridge from journal replay into the coordinator's
+        CurveStore."""
+        with self._lock:
+            out = self._replayed_curves
+            self._replayed_curves = []
+        return out
 
     def record_mesh_generation(
         self, generation: int, reason: Optional[str] = None
@@ -519,6 +574,7 @@ class JobStore:
                         job["completed_subtasks"]
                         + job["failed_subtasks"]
                         + job.get("pruned_subtasks", 0)
+                        + job.get("diverged_subtasks", 0)
                     )
                     pending += max(int(job["total_subtasks"]) - done, 0)
         return {
@@ -575,7 +631,11 @@ class JobStore:
         with self._lock:
             job = self._require_job(sid, job_id)
             pruned = job.get("pruned_subtasks", 0)
-            done = job["completed_subtasks"] + job["failed_subtasks"] + pruned
+            diverged = job.get("diverged_subtasks", 0)
+            done = (
+                job["completed_subtasks"] + job["failed_subtasks"]
+                + pruned + diverged
+            )
             out = {
                 # the CANONICAL (shard-stamped) id rides every progress/SSE
                 # event, so a client that submitted under a client-minted
@@ -593,6 +653,12 @@ class JobStore:
                 # controller stopped early — non-failure terminals that
                 # ride the SSE stream so clients can show rung progress
                 "tasks_pruned": pruned,
+                # numerical-health watchdog (docs/OBSERVABILITY.md "Trial
+                # telemetry plane"): trials terminated because their
+                # learning curve went non-finite or blew past the
+                # divergence threshold — non-failure terminals, streamed
+                # like tasks_pruned
+                "tasks_diverged": diverged,
                 "total_subtasks": job["total_subtasks"],
                 "job_result": job["result"]
                 if job["status"] in TERMINAL_STATUSES
@@ -717,6 +783,7 @@ class JobStore:
                 # pruned counter — seed it so the shared transition logic
                 # (and its done arithmetic) is total on old records
                 job.setdefault("pruned_subtasks", 0)
+                job.setdefault("diverged_subtasks", 0)
                 sub = job["subtasks"][e["stid"]]
                 self._apply_subtask_update(
                     job, sub, e["status"], e.get("result")
@@ -776,6 +843,25 @@ class JobStore:
                     "attempt": int(e.get("attempt", 0) or 0),
                     "ts": time.time(),
                 }
+            elif op == "curve":
+                # trial telemetry plane: restore /curves history. Guard
+                # on the job existing — a truncated journal may carry a
+                # curve for a job whose create_job entry was torn away
+                if e["jid"] not in self._sessions[e["sid"]]["jobs"]:
+                    return False
+                if not isinstance(e.get("curve"), dict):
+                    return False
+                self._replayed_curves.append(
+                    {
+                        "sid": e["sid"],
+                        "jid": e["jid"],
+                        "stid": e["stid"],
+                        "rung": int(e.get("rung", 0) or 0),
+                        "attempt": int(e.get("attempt", 0) or 0),
+                        "diverged": bool(e.get("diverged")),
+                        "curve": e["curve"],
+                    }
+                )
             elif op == "finalize_job":
                 job = self._sessions[e["sid"]]["jobs"][e["jid"]]
                 job["result"] = e["result"]

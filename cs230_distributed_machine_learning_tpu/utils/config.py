@@ -211,6 +211,13 @@ class ServiceConfig:
     # donor-side steal lease: a tombstone older than this with no result
     # from the thief is reclaimed (fresh attempt fences the thief)
     steal_lease_s: float = 120.0
+    # ---- trial telemetry plane (docs/OBSERVABILITY.md "Trial telemetry
+    # plane"): numerical-health watchdog threshold. A trial whose curve
+    # tail (loss or grad-norm) exceeds this factor x the median of its
+    # own early trace — or contains any non-finite sample — is marked
+    # diverged and its in-flight attempt is cooperatively cancelled.
+    # <= 0 disables the ratio rule (non-finite still trips).
+    curve_divergence_factor: float = 1e3
 
 
 @dataclasses.dataclass

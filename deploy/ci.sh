@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI gate, runnable locally or from .github/workflows/ci.yml:
-#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs|trace|rebalance]
+#   ./ci.sh [fast|kernels|chaos|search|perf|loadtest|multichip|streaming|obs|trace|rebalance|curves]
 #   (default: fast)
 #
 #   fast mode:
@@ -93,6 +93,20 @@
 #   ≈ store wall and ≥80 % of the delta attributed), refreshing
 #   CRITICAL_PATH.json into bench-artifacts/ and re-validating the
 #   Perfetto export the drill wrote as loadable Chrome trace JSON.
+#
+#   curves mode (every push in ci.yml, fast): the trial-telemetry-plane
+#   gate (docs/OBSERVABILITY.md "Trial telemetry plane") — the curve
+#   capture/store/watchdog suites (tests/test_telemetry_curves.py: trace-tail ==
+#   reported-score parity across fused+legacy scan bodies, stride
+#   downsampling at non-multiple max_iter, the CS230_CURVES=0 strict
+#   no-op pin, the live-socket watchdog e2e, curve-op journal truncation
+#   fuzz, the SSE curve round-trip through a front end) plus the search
+#   e2e suite whose diverged-terminal arithmetic curves ride. With
+#   CURVES_FULL=1 (nightly/dispatch) it additionally runs
+#   benchmarks/curve_micro.py (capture-overhead <= 3% gate, the
+#   diverging-lr <30%-budget watchdog drill, survivor parity) and
+#   uploads the fresh CURVE_MICRO.json (the committed acceptance
+#   artifact is benchmarks/CURVE_MICRO.json).
 #
 #   rebalance mode (every push in ci.yml, fast): the cross-shard
 #   rebalancing gate (docs/ROBUSTNESS.md "Shard rebalancing") — the
@@ -358,6 +372,33 @@ PYEOF
   then
     echo "Perfetto validity gate FAILED"
     rc=1
+  fi
+elif [ "$MODE" = "curves" ]; then
+  echo "== trial telemetry plane suites (JAX_PLATFORMS=cpu) =="
+  CS230_JOURNAL_DIR="$ART_DIR/journal" \
+  CS230_METRICS_SNAPSHOT="$ART_DIR/metrics.prom" \
+  CS230_EVENTS_SNAPSHOT="$ART_DIR/events_ring.jsonl" \
+  JAX_PLATFORMS=cpu python -m pytest \
+    tests/test_telemetry_curves.py tests/test_search_e2e.py \
+    -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || rc=$?
+  if [ "${CURVES_FULL:-0}" = "1" ]; then
+    # nightly/dispatch: the full micro-benchmark — capture overhead
+    # <= 3% (interleaved on/off pairs), the diverging-lr watchdog drill
+    # (< 30% of max_resource consumed), survivor parity under
+    # CS230_CURVES=0; the fresh JSON is uploaded for trend-watching
+    # (the committed acceptance artifact is benchmarks/CURVE_MICRO.json)
+    echo "== FULL curve micro-benchmark (overhead + watchdog gates) =="
+    mkdir -p bench-artifacts
+    if JAX_PLATFORMS=cpu python benchmarks/curve_micro.py \
+        > bench-artifacts/curve_micro.log 2>&1; then
+      cp benchmarks/CURVE_MICRO.json bench-artifacts/ || true
+      tail -n 3 bench-artifacts/curve_micro.log
+    else
+      echo "curve_micro FAILED (see bench-artifacts/curve_micro.log)"
+      tail -n 20 bench-artifacts/curve_micro.log
+      rc=1
+    fi
   fi
 elif [ "$MODE" = "rebalance" ]; then
   echo "== cross-shard rebalancing suite (JAX_PLATFORMS=cpu) =="

@@ -15,6 +15,7 @@ import threading
 import time
 import uuid
 
+import numpy as np
 import requests
 from sklearn.linear_model import LogisticRegression
 from sklearn.model_selection import GridSearchCV
@@ -557,3 +558,74 @@ def test_late_result_forwarding_relays_each_subtask_once():
         srv_b.shutdown()
         cluster_a.shutdown()
         cluster_b.shutdown()
+
+
+def test_heterogeneous_fleet_steal_is_mesh_aware():
+    """Width-priced stealing on a heterogeneous donor (a 4-device and a
+    1-device worker): candidates carry the owning slice's ``n_devices``,
+    ``max_n_devices`` fences grants to what the thief can serve, and
+    ``prefer_wide`` hands the widest-priced work out first."""
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+
+    materialize_builtin("iris")
+    svc = get_config().service
+    prior = (svc.rebalance_enabled, svc.rebalance_hot_pressure)
+    cluster = ClusterRuntime(shard_id=0)
+    # no executors: remote registrations queue deterministically
+    wide = cluster.register_remote(None, n_devices=4)
+    narrow = cluster.register_remote(None, n_devices=1)
+    coord = Coordinator(cluster=cluster, shard_id=0, n_shards=2)
+    try:
+        svc.rebalance_enabled = True
+        svc.rebalance_hot_pressure = 0.0
+        sid = coord.create_session()
+        # enough trials that mesh packing (est / n_devices) spills past
+        # the wide slice and queues >=2 on the narrow worker too
+        payload = {
+            **_GRID,
+            "model_details": extract_model_details(
+                GridSearchCV(
+                    LogisticRegression(max_iter=50),
+                    {"C": list(np.geomspace(0.01, 100.0, 12))},
+                    cv=3,
+                )
+            ),
+        }
+        coord.submit_train(sid, payload)
+        _wait_queued(cluster, 12)
+        queues = cluster.engine.queue_snapshot()
+        width_of = {
+            stid: (4 if wid == wide else 1)
+            for wid, q in queues.items()
+            for stid in q[1:]
+        }
+        assert set(width_of.values()) == {1, 4}  # both widths offerable
+
+        coord.signals.evaluate(force=True)
+        offer = coord.steal_candidates()
+        assert {
+            c["subtask_id"]: c["n_devices"] for c in offer["candidates"]
+        } == width_of
+
+        # a 1-device thief can only pull 1-device-priced work
+        narrow_grants = coord.release_for_steal(1, max_n=8, max_n_devices=1)
+        assert narrow_grants  # something narrow was queued
+        assert {t["subtask_id"] for t in narrow_grants} == {
+            s for s, w in width_of.items() if w == 1
+        }
+
+        # a wide thief pulls the widest-priced candidate first
+        wide_grant = coord.release_for_steal(
+            1, max_n=1, max_n_devices=4, prefer_wide=True
+        )
+        assert len(wide_grant) == 1
+        assert width_of[wide_grant[0]["subtask_id"]] == 4
+        # grants are fenced fresh attempts, tombstoned on the donor
+        for t in narrow_grants + wide_grant:
+            assert int(t.get("attempt") or 0) >= 1
+            assert t["subtask_id"] in coord.store.steal_tombstones
+    finally:
+        svc.rebalance_enabled, svc.rebalance_hot_pressure = prior
+        cluster.shutdown()

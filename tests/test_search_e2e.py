@@ -62,7 +62,8 @@ def test_asha_multiworker_job_prunes_promotes_and_completes(search_cfg):
         m = MLTaskManager(coordinator=coord)
         promoted0 = _counter("tpuml_trials_promoted_total")
         pruned0 = _counter("tpuml_trials_pruned_total")
-        saved0 = _counter("tpuml_device_seconds_saved_total")
+        saved0 = _counter("tpuml_device_seconds_saved_total",
+                          reason="prune")
         status = m.train(_asha_job(), "iris", show_progress=False,
                          timeout=300)
         assert status["job_status"] == "completed"
@@ -102,7 +103,8 @@ def test_asha_multiworker_job_prunes_promotes_and_completes(search_cfg):
         assert all(n == 1 for n in seen.values())
         assert _counter("tpuml_trials_promoted_total") - promoted0 == len(promotes)
         assert _counter("tpuml_trials_pruned_total") - pruned0 == jr["n_pruned"]
-        assert _counter("tpuml_device_seconds_saved_total") > saved0
+        assert _counter("tpuml_device_seconds_saved_total",
+                        reason="prune") > saved0
     finally:
         cluster.shutdown()
 

@@ -1,0 +1,602 @@
+"""Trial telemetry plane (docs/OBSERVABILITY.md "Trial telemetry plane"):
+in-fit learning-curve capture, the numerical-health watchdog, and live
+curve serving.
+
+The contracts pinned here:
+
+- **the curve is the fit**: the trace tail a kernel emits from inside its
+  fit scan equals the final cross-validation scores bit-for-bit — the
+  curve observes the optimizer, it never runs a second one;
+- **strict no-op**: ``CS230_CURVES=0`` produces bit-identical scores with
+  no ``curve`` key anywhere (the off state is the pre-curves jaxpr, keyed
+  apart by ``trace_salt``);
+- **fused-step parity**: the Pallas fused Nesterov step and the legacy
+  scan body emit matching grad-norm traces (the capture rides both
+  bodies);
+- the watchdog terminates a numerically exploding trial as ``diverged``
+  (never ``failed``) early in its rung ladder, end to end over a real
+  socket;
+- curve journal entries replay through crash-point truncation exactly
+  like every other op, and stream incrementally as ``kind=curve`` SSE
+  events through a stateless front end.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+from sklearn.linear_model import LogisticRegression
+from sklearn.model_selection import GridSearchCV
+
+from cs230_distributed_machine_learning_tpu.models.base import TrialData
+from cs230_distributed_machine_learning_tpu.models.registry import get_kernel
+from cs230_distributed_machine_learning_tpu.obs import REGISTRY
+from cs230_distributed_machine_learning_tpu.obs.curves import (
+    CurveStore,
+    build_curve_record,
+    curve_points,
+    curves_salt,
+    divergence,
+    last_k_slope,
+    trace_stride,
+)
+from cs230_distributed_machine_learning_tpu.ops.folds import build_split_plan
+from cs230_distributed_machine_learning_tpu.parallel import trial_map
+
+
+def _counter(name, **labels) -> float:
+    c = REGISTRY.get(name)
+    return c.value(**labels) if c is not None else 0.0
+
+
+def _toy(n=200, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.int32)
+    return TrialData(X=X, y=y, n_classes=2)
+
+
+def _run_logreg(params, n_folds=3):
+    data = _toy()
+    plan = build_split_plan(np.asarray(data.y), task="classification",
+                            n_folds=n_folds)
+    kernel = get_kernel("LogisticRegression")
+    trial_map._compiled_cache.clear()
+    return trial_map.run_trials(kernel, data, plan, params)
+
+
+# ---------------------------------------------------------------------
+# capture: the curve record is the fit's own trace
+# ---------------------------------------------------------------------
+
+
+def test_curve_record_tail_is_the_fit(monkeypatch):
+    """Every trial's metrics carry a v1 curve record whose per-split tail
+    IS the final scores: tail[0] is the holdout score, tail[1:] equals
+    cv_scores exactly (same floats, same transport)."""
+    monkeypatch.setenv("CS230_CURVES", "auto")
+    run = _run_logreg([{"C": 1.0, "max_iter": 100},
+                       {"C": 0.1, "max_iter": 100}], n_folds=3)
+    for m in run.trial_metrics:
+        rec = m["curve"]
+        assert rec["v"] == 1
+        assert rec["nonfinite"] is False
+        assert "diverged" not in m
+        # newton path on this shape: scan length = _NEWTON_STEPS
+        assert rec["steps"] == 25
+        assert rec["stride"] == trace_stride(rec["steps"])
+        used = math.ceil(rec["steps"] / rec["stride"])
+        # one gmax row per split (holdout + each fold), trimmed to the
+        # populated prefix, every sample finite
+        assert len(rec["gmax"]) == 1 + 3
+        for row in rec["gmax"]:
+            assert len(row) == used
+            assert all(v is not None for v in row)
+        assert rec["tail"][1:] == m["cv_scores"]
+        assert np.isfinite(m["mean_cv_score"])
+
+
+def test_curve_points_stride_downsampling(monkeypatch):
+    """CS230_CURVE_POINTS bounds the buffer: stride = ceil(steps/points),
+    rows trim to ceil(steps/stride), and the last slot still holds the
+    final sample (last-write-wins within a stride window)."""
+    monkeypatch.setenv("CS230_CURVES", "auto")
+    monkeypatch.setenv("CS230_CURVE_POINTS", "16")
+    assert curve_points() == 16
+    run = _run_logreg([{"C": 1.0, "max_iter": 100}])
+    rec = run.trial_metrics[0]["curve"]
+    steps = rec["steps"]
+    assert rec["stride"] == math.ceil(steps / 16)
+    used = math.ceil(steps / rec["stride"])
+    assert used <= 16
+    assert (used - 1) * rec["stride"] < steps <= used * rec["stride"]
+    for row in rec["gmax"]:
+        assert len(row) == used
+
+
+def test_strict_noop_off_state(monkeypatch):
+    """CS230_CURVES=0 is the pre-curves path: no curve key in any trial's
+    metrics, scores BIT-identical to the capture-on run, and the two
+    states compile apart (curves_salt joins trace_salt)."""
+    params = [{"C": 1.0, "max_iter": 100}, {"C": 10.0, "max_iter": 100}]
+
+    monkeypatch.setenv("CS230_CURVES", "auto")
+    salt_on = curves_salt()
+    run_on = _run_logreg(params)
+    assert all("curve" in m for m in run_on.trial_metrics)
+
+    monkeypatch.setenv("CS230_CURVES", "0")
+    salt_off = curves_salt()
+    run_off = _run_logreg(params)
+    assert salt_off != salt_on
+    for m_on, m_off in zip(run_on.trial_metrics, run_off.trial_metrics):
+        assert "curve" not in m_off
+        assert m_off["mean_cv_score"] == m_on["mean_cv_score"]  # bitwise
+        assert m_off["cv_scores"] == m_on["cv_scores"]
+
+
+def test_strict_noop_no_store_growth(monkeypatch):
+    """The off state end to end: a job run under CS230_CURVES=0 grows
+    neither the coordinator's curve store nor the ingest counter, no
+    result carries a curve, and /curves serves an honest empty list."""
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        ClusterRuntime,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+
+    monkeypatch.setenv("CS230_CURVES", "0")
+    materialize_builtin("iris")
+    before = _counter("tpuml_curve_points_total")
+    cluster = ClusterRuntime()
+    cluster.add_executor()
+    try:
+        coord = Coordinator(cluster=cluster)
+        m = MLTaskManager(coordinator=coord)
+        status = m.train(
+            GridSearchCV(LogisticRegression(max_iter=50),
+                         {"C": [0.1, 1.0]}, cv=3),
+            "iris", show_progress=False, timeout=120,
+        )
+        assert status["job_status"] == "completed"
+        assert all(
+            "curve" not in r for r in status["job_result"]["results"]
+        )
+        assert coord.curves.n_entries() == 0
+        assert _counter("tpuml_curve_points_total") == before
+        body = coord.job_curves(m.job_id)
+        assert body["n_curves"] == 0 and body["curves"] == []
+    finally:
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------
+# fused-step kernel parity (packed path, interpret mode)
+# ---------------------------------------------------------------------
+
+
+def _packed_fn(monkeypatch, fused_mode, curves_state, n, d, c, S, chunk):
+    import jax
+
+    monkeypatch.setenv("CS230_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("CS230_FUSED_STEP", fused_mode)
+    monkeypatch.setenv("CS230_CURVES", curves_state)
+    jax.clear_caches()
+    kernel = get_kernel("LogisticRegression")
+    static = {
+        "fit_intercept": True, "penalty": "l2",
+        "_method": "nesterov", "_n_classes": c, "_iters": 8,
+    }
+    fn = kernel.build_batched_fn(
+        static=static, n=n, d=d, n_classes=c, n_splits=S, chunk=chunk
+    )
+    assert fn is not None
+    return fn
+
+
+def test_packed_fused_step_curve_parity(monkeypatch):
+    """The packed-path grad-norm trace rides both scan bodies: the Pallas
+    fused step (interpret) and the legacy body emit matching curves, and
+    the off state emits none while scoring bit-identically."""
+    import jax.numpy as jnp
+
+    n, d, c, S, chunk = 320, 5, 3, 2, 128
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(n, d).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, c, n).astype(np.int32))
+    TW = jnp.asarray((rng.rand(S, n) > 0.3).astype(np.float32))
+    EW = jnp.asarray((rng.rand(S, n) > 0.5).astype(np.float32))
+    hyper = {
+        "C": jnp.asarray(np.geomspace(0.05, 5.0, chunk).astype(np.float32)),
+        "max_iter": jnp.asarray(np.full(chunk, 8.0, np.float32)),
+        "tol": jnp.asarray(np.full(chunk, 1e-6, np.float32)),
+    }
+
+    out_legacy = _packed_fn(monkeypatch, "legacy", "auto",
+                            n, d, c, S, chunk)(X, y, TW, EW, hyper)
+    out_fused = _packed_fn(monkeypatch, "pallas", "auto",
+                           n, d, c, S, chunk)(X, y, TW, EW, hyper)
+    used = math.ceil(8 / trace_stride(8))
+    for out in (out_legacy, out_fused):
+        assert out["curve_gmax"].shape == (chunk, S, used)
+        assert float(np.asarray(out["curve_stride"]).flat[0]) == trace_stride(8)
+        assert float(np.asarray(out["curve_steps"]).flat[0]) == 8.0
+    g_legacy = np.asarray(out_legacy["curve_gmax"])
+    g_fused = np.asarray(out_fused["curve_gmax"])
+    assert np.all(np.isfinite(g_legacy)) and np.all(np.isfinite(g_fused))
+    np.testing.assert_allclose(g_fused, g_legacy, rtol=2e-2, atol=1e-2)
+
+    # off state: no curve leaves, identical scores within the same mode
+    out_off = _packed_fn(monkeypatch, "pallas", "0",
+                         n, d, c, S, chunk)(X, y, TW, EW, hyper)
+    assert not any(k.startswith("curve_") for k in out_off)
+    np.testing.assert_array_equal(
+        np.asarray(out_off["score"]), np.asarray(out_fused["score"])
+    )
+
+
+# ---------------------------------------------------------------------
+# watchdog rule + store (pure units)
+# ---------------------------------------------------------------------
+
+
+def test_divergence_rule_and_slope():
+    ok = build_curve_record(
+        {"gmax": np.geomspace(10.0, 0.01, 32)}, 1, 32, tail=[0.9, 0.9]
+    )
+    assert divergence(ok, 1e3) is False
+
+    # non-finite anywhere trips immediately
+    bad = build_curve_record(
+        {"loss": [1.0, 2.0, float("nan"), 4.0]}, 1, 4, tail=[0.1]
+    )
+    assert bad["nonfinite"] is True
+    assert bad["loss"][0][2] is None  # JSON-safe: NaN -> null
+    assert divergence(bad, 1e3) is True
+
+    # finite blow-up: tail >> median of the early quarter
+    grow = build_curve_record(
+        {"loss": np.geomspace(1.0, 1e7, 32)}, 1, 32, tail=[0.1]
+    )
+    assert divergence(grow, 1e3) is True
+    assert divergence(grow, 1e9) is False  # factor is the knob
+
+    # short traces never trip the ratio rule (needs 4 early points)
+    short = build_curve_record({"loss": [1.0, 1e6]}, 1, 2, tail=[0.1])
+    assert divergence(short, 1e3) is False
+
+    assert last_k_slope([1.0, 2.0, 3.0, 4.0]) == pytest.approx(1.0)
+    assert last_k_slope([5.0, None, 5.0]) == pytest.approx(0.0)
+    assert last_k_slope([1.0]) == 0.0
+
+
+def test_curve_store_dedup_updates_and_bounds():
+    store = CurveStore(max_entries_per_job=2, max_jobs=2)
+    rec = build_curve_record({"gmax": [1.0, 0.5, 0.1, 0.05]}, 1, 4,
+                             tail=[0.9])
+    added = store.ingest("j1", "s0", rec, rung=0, attempt=0)
+    assert added == 4
+    # same (subtask, rung, attempt) re-delivered over the other
+    # transport: counts once
+    assert store.ingest("j1", "s0", rec, rung=0, attempt=0) == 0
+    assert store.ingest("j1", "s0", rec, rung=1, attempt=0) == 4
+    assert store.n_entries("j1") == 2
+
+    fresh, mark = store.updates("j1", 0)
+    assert [e["rung"] for e in fresh] == [0, 1]
+    again, mark2 = store.updates("j1", mark)
+    assert again == [] and mark2 == mark  # cursor is the SSE dedup
+
+    # per-job cap evicts the oldest entry
+    store.ingest("j1", "s1", rec, rung=0)
+    assert store.n_entries("j1") == 2
+    assert store.subtask("j1", "s1") is not None
+
+    store.mark_diverged("j1", "s1")
+    entry = store.subtask("j1", "s1")["curves"][-1]
+    assert entry["diverged"] is True
+    # divergence bumps the version so a live stream re-sends the entry
+    fresh, _ = store.updates("j1", mark)
+    assert any(e["subtask_id"] == "s1" and e["diverged"] for e in fresh)
+
+    assert store.job("nope") is None
+    assert store.subtask("j1", "nope") is None
+
+
+# ---------------------------------------------------------------------
+# journal replay: curve ops survive crash-point truncation
+# ---------------------------------------------------------------------
+
+
+def _curve_journal(jd):
+    from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+
+    store = JobStore(journal_dir=jd)
+    sid = store.create_session()
+    store.create_job(
+        sid, "cj", {}, [{"subtask_id": "cj-s0"}, {"subtask_id": "cj-s1"}]
+    )
+    rec = build_curve_record({"gmax": [1.0, 0.5, 0.1, 0.05]}, 1, 4,
+                             tail=[0.9])
+    store.record_curve(sid, "cj", "cj-s0", rec, rung=0)
+    store.record_curve(sid, "cj", "cj-s0", rec, rung=1)
+    bad = build_curve_record({"loss": [1.0, float("inf")] * 4}, 1, 8,
+                             tail=[0.0])
+    store.record_curve(sid, "cj", "cj-s1", bad, rung=0, diverged=True)
+    return sid
+
+
+def test_curve_journal_crash_point_fuzz(tmp_path):
+    """Replay must never raise wherever a crash truncated the journal,
+    and the drained curves are exactly the intact curve lines — a curve
+    whose create_job was torn away is dropped, not crashed on."""
+    from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+
+    jd_full = str(tmp_path / "full")
+    _curve_journal(jd_full)
+    raw = open(os.path.join(jd_full, "jobs.jsonl"), "rb").read()
+    lines = raw.splitlines(keepends=True)
+    n_curve_lines = [
+        json.loads(ln).get("op") == "curve" for ln in lines
+    ]
+    assert sum(n_curve_lines) == 3
+
+    for i in range(len(lines) + 1):
+        jd = str(tmp_path / f"cut{i}")
+        os.makedirs(jd)
+        with open(os.path.join(jd, "jobs.jsonl"), "wb") as f:
+            f.writelines(lines[:i])
+        cut = JobStore(journal_dir=jd)  # must never raise
+        assert cut.replay_skipped == 0
+        drained = cut.drain_replayed_curves()
+        assert len(drained) == sum(n_curve_lines[:i])
+        assert cut.drain_replayed_curves() == []  # exactly-once drain
+        for e in drained:
+            assert e["jid"] == "cj"
+            assert isinstance(e["curve"], dict) and e["curve"]["v"] == 1
+    # the full journal round-trips the watchdog verdict
+    full = JobStore(journal_dir=jd_full)
+    assert full.replay_ops.get("curve") == 3
+    drained = full.drain_replayed_curves()
+    assert [e["rung"] for e in drained] == [0, 1, 0]
+    assert [e["diverged"] for e in drained] == [False, False, True]
+    # the non-finite loss samples came back as JSON nulls, verdict intact
+    assert divergence(drained[2]["curve"], 1e3) is True
+
+
+def test_curve_journal_torn_write_skipped(tmp_path):
+    """A torn final curve line is skipped by replay (checksummed lines),
+    leaving the intact prefix served."""
+    from cs230_distributed_machine_learning_tpu.runtime.store import JobStore
+
+    jd_full = str(tmp_path / "full")
+    _curve_journal(jd_full)
+    raw = open(os.path.join(jd_full, "jobs.jsonl"), "rb").read()
+    lines = raw.splitlines(keepends=True)
+
+    jd = str(tmp_path / "torn")
+    os.makedirs(jd)
+    with open(os.path.join(jd, "jobs.jsonl"), "wb") as f:
+        f.writelines(lines[:-1])
+        f.write(lines[-1][: len(lines[-1]) // 2])  # torn mid-line
+    store = JobStore(journal_dir=jd)
+    assert store.replay_skipped == 1
+    assert store.replay_ops.get("curve") == 2
+    assert len(store.drain_replayed_curves()) == 2
+
+
+# ---------------------------------------------------------------------
+# end to end: the watchdog over a real socket
+# ---------------------------------------------------------------------
+
+
+def _asha_mlp_job():
+    # one lr that explodes to non-finite loss inside rung 0; a clearly
+    # best config so the winner is ordering-independent
+    return {
+        "model_type": "MLPClassifier",
+        "search_type": "asha",
+        "base_estimator_params": {
+            "hidden_layer_sizes": (4,),
+            "solver": "sgd",
+            "random_state": 0,
+        },
+        "param_grid": {"learning_rate_init": [0.05, 0.02, 1e6]},
+        "cv_params": {"cv": 2},
+        "n_iter": 3,
+        "asha": {"eta": 3, "min_resource": 10, "max_resource": 30},
+    }
+
+
+@pytest.mark.filterwarnings("ignore")
+def test_watchdog_terminates_diverging_trial_over_socket(monkeypatch):
+    """A numerically exploding ASHA trial terminates as ``diverged`` —
+    never ``failed``, never promoted past rung 0 — and its curve history
+    is served over ``GET /curves`` with the verdict attached."""
+    from werkzeug.serving import make_server
+
+    from cs230_distributed_machine_learning_tpu import MLTaskManager
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        ClusterRuntime,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.server import (
+        create_app,
+    )
+
+    monkeypatch.setenv("CS230_CURVES", "auto")
+    materialize_builtin("iris")
+    before = _counter("tpuml_trials_diverged_total")
+    cluster = ClusterRuntime()
+    cluster.add_executor()
+    coord = Coordinator(cluster=cluster)
+    server = make_server("127.0.0.1", 0, create_app(coord), threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{server.server_port}"
+        m = MLTaskManager(url=url)
+        status = m.train(_asha_mlp_job(), "iris", show_progress=False,
+                         timeout=300)
+        assert status["job_status"] == "completed"
+        jr = status["job_result"]
+
+        # the exploding lr diverged, early, and nothing FAILED
+        assert jr.get("failed") == []
+        diverged = jr.get("diverged_results") or []
+        assert len(diverged) == 1
+        (dv,) = diverged
+        assert dv["status"] == "diverged"
+        assert dv["parameters"].get("learning_rate_init") == 1e6
+        assert int((dv.get("asha") or {}).get("rung") or 0) == 0
+        assert jr.get("n_diverged") == 1
+        # the winner is a sane lr
+        assert jr["best_result"]["parameters"]["learning_rate_init"] < 1.0
+        assert _counter("tpuml_trials_diverged_total") == before + 1
+
+        # curve history over the wire: job view, diverged flag, per-trial
+        # route, 404 contract
+        body = requests.get(f"{url}/curves/{m.job_id}", timeout=30).json()
+        assert body["job_status"] == "completed"
+        assert body["n_curves"] >= 1
+        assert body["tasks_diverged"] == 1
+        flagged = [e for e in body["curves"] if e["diverged"]]
+        assert flagged and flagged[0]["curve"]["nonfinite"] is True
+        stid = flagged[0]["subtask_id"]
+        sub = m.curves(subtask_id=stid)
+        assert sub["subtask_id"] == stid
+        assert all(e["curve"]["v"] == 1 for e in sub["curves"])
+        with pytest.raises(KeyError):
+            m.curves(subtask_id="no-such-subtask")
+        r = requests.get(f"{url}/curves/no-such-job", timeout=30)
+        assert r.status_code == 404
+    finally:
+        server.shutdown()
+        cluster.shutdown()
+
+
+# ---------------------------------------------------------------------
+# end to end: SSE curve events through a stateless front end
+# ---------------------------------------------------------------------
+
+
+def test_sse_curve_events_and_frontend_routing(monkeypatch):
+    """Curves stream as ``kind=curve`` SSE events interleaved with the
+    progress snapshots — and both the stream and the ``/curves`` routes
+    resolve through a stateless front end by the job-id stamp."""
+    from werkzeug.serving import make_server
+
+    from cs230_distributed_machine_learning_tpu.client.introspection import (
+        extract_model_details,
+    )
+    from cs230_distributed_machine_learning_tpu.data.datasets import (
+        materialize_builtin,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.cluster import (
+        ClusterRuntime,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.coordinator import (
+        Coordinator,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.frontend import (
+        create_frontend_app,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.server import (
+        create_app,
+    )
+    from cs230_distributed_machine_learning_tpu.runtime.sharding import (
+        shard_service_config,
+    )
+    from cs230_distributed_machine_learning_tpu.utils.config import (
+        get_config,
+    )
+
+    monkeypatch.setenv("CS230_CURVES", "auto")
+    materialize_builtin("iris")
+    cfg = shard_service_config(get_config(), 1)
+    cluster = ClusterRuntime(shard_id=0)
+    cluster.add_executor()
+    coord = Coordinator(config=cfg, cluster=cluster, shard_id=0, n_shards=1)
+    shard = make_server("127.0.0.1", 0, create_app(coord), threaded=True)
+    threading.Thread(target=shard.serve_forever, daemon=True).start()
+    fe_srv = make_server(
+        "127.0.0.1", 0,
+        create_frontend_app([f"http://127.0.0.1:{shard.server_port}"]),
+        threaded=True,
+    )
+    threading.Thread(target=fe_srv.serve_forever, daemon=True).start()
+    fe = f"http://127.0.0.1:{fe_srv.server_port}"
+    try:
+        sid = requests.post(f"{fe}/create_session",
+                            timeout=30).json()["session_id"]
+        payload = {
+            "dataset_id": "iris",
+            "model_details": extract_model_details(
+                GridSearchCV(LogisticRegression(max_iter=50),
+                             {"C": [0.1, 1.0]}, cv=3)
+            ),
+            "train_params": {"test_size": 0.2, "random_state": 0},
+        }
+        jid = requests.post(f"{fe}/train/{sid}", json=payload,
+                            timeout=60).json()["job_id"]
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            body = requests.get(f"{fe}/check_status/{sid}/{jid}",
+                                timeout=30).json()
+            if body.get("job_status") == "completed":
+                break
+            time.sleep(0.2)
+        assert body["job_status"] == "completed"
+
+        # SSE resume by job id through the front end: every stored curve
+        # flushes before the terminal snapshot (progress-first read means
+        # nothing is lost to the stream's return)
+        events = []
+        with requests.post(f"{fe}/train_status/{sid}", json={"job_id": jid},
+                           stream=True, timeout=60) as r:
+            assert r.status_code == 200
+            for line in r.iter_lines(chunk_size=1):
+                if not line.startswith(b"data: "):
+                    continue
+                evt = json.loads(line[len(b"data: "):])
+                events.append(evt)
+                if evt.get("job_status") == "completed":
+                    break
+        curve_events = [e for e in events if e.get("kind") == "curve"]
+        assert len(curve_events) == 2  # one per trial
+        for e in curve_events:
+            assert e["job_id"] == jid
+            assert e["curve"]["v"] == 1
+            assert e["diverged"] is False
+        # curve events precede the terminal snapshot
+        assert events[-1].get("kind") is None
+
+        # /curves routes by the job-id stamp through the front end
+        body = requests.get(f"{fe}/curves/{jid}", timeout=30).json()
+        assert body["n_curves"] == 2
+        assert body["tasks_diverged"] == 0
+        stid = body["curves"][0]["subtask_id"]
+        sub = requests.get(f"{fe}/curves/{jid}/{stid}", timeout=30)
+        assert sub.status_code == 200
+        assert sub.json()["subtask_id"] == stid
+        assert requests.get(f"{fe}/curves/{jid}/nope",
+                            timeout=30).status_code == 404
+    finally:
+        fe_srv.shutdown()
+        shard.shutdown()
+        cluster.shutdown()
